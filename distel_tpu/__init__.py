@@ -1,0 +1,40 @@
+"""distel_tpu — a TPU-native distributed fixed-point classifier for EL+ ontologies.
+
+A from-scratch rebuild of the capabilities of DistEL (Redis/Java rule-sharded
+saturation; see /root/reference) designed TPU-first:
+
+* the subsumption store S(X) becomes a boolean matrix ``S[x, a]`` ("a is a
+  subsumer of x"), sharded over a ``jax.sharding.Mesh`` along the concept axis;
+* role-pair stores R(r) become a *link matrix* ``R[x, l]`` over the finite set
+  of (role, filler) pairs that can ever appear during EL+ saturation;
+* every completion rule CR1-CR6 (the "Pushing the EL Envelope" rule set the
+  reference implements as Redis Lua kernels, reference
+  ``src/knoelab/classification/base/*AxiomProcessorBase.java``) becomes a
+  column gather/scatter or a single AND-OR semiring matmul on the MXU;
+* the global barrier + convergence vote (reference
+  ``controller/CommunicationHandler.java:49-84``) becomes
+  ``lax.while_loop(cond=any(changed))`` with a ``psum`` over the mesh.
+
+Layer map (mirrors SURVEY.md section 1 of the rebuild blueprint):
+
+=========  ==========================  =====================================
+Layer      Package                     Reference equivalent
+=========  ==========================  =====================================
+frontend   ``distel_tpu.owl``          OWLAPI + functional-syntax loading
+frontend   ``distel_tpu.frontend``     ``init/Normalizer.java``, profile tools
+indexing   ``distel_tpu.core.indexing``  ``init/AxiomLoader.java`` (int-IDing,
+                                       categorization, shard layout)
+kernels    ``distel_tpu.core.engine``  ``base/Type*AxiomProcessorBase.java``
+                                       + the ~12 embedded Lua scripts
+oracle     ``distel_tpu.core.oracle``  (new: the unit-test oracle the
+                                       reference lacked)
+parallel   ``distel_tpu.parallel``     Redis sharding + CommunicationHandler
+runtime    ``distel_tpu.runtime``      ``ELClassifier.java`` + scripts/
+testing    ``distel_tpu.testing``      ``test/ELClassifierTest.java`` et al.
+=========  ==========================  =====================================
+"""
+
+__version__ = "0.1.0"
+
+from distel_tpu.owl import parser as owl_parser  # noqa: F401
+from distel_tpu.owl import syntax as owl_syntax  # noqa: F401

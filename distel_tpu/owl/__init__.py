@@ -1,0 +1,1 @@
+"""OWL 2 EL frontend: AST, functional-syntax parser, serializer."""

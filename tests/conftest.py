@@ -1,0 +1,16 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's single-host-multi-shard test mode ("minimum of 7
+Redis instances ... on the single machine", reference README.md:43): real
+protocol, colocated shards. Here: real GSPMD partitioning, virtual devices.
+Must run before any `import jax`.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

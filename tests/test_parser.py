@@ -1,0 +1,123 @@
+from distel_tpu.owl import parser, syntax as S
+from distel_tpu.owl.writer import ontology_to_str
+
+PIZZA_MINI = """
+Prefix(:=<http://example.org/pizza#>)
+Prefix(owl:=<http://www.w3.org/2002/07/owl#>)
+Ontology(<http://example.org/pizza>
+Declaration(Class(:Pizza))
+Declaration(Class(:MeatyPizza))
+Declaration(ObjectProperty(:hasTopping))
+Declaration(NamedIndividual(:myPizza))
+SubClassOf(:MeatyPizza :Pizza)
+SubClassOf(:MeatyPizza ObjectSomeValuesFrom(:hasTopping :MeatTopping))
+EquivalentClasses(:VegPizza ObjectIntersectionOf(:Pizza :NoMeat))
+DisjointClasses(:MeatTopping :VegTopping)
+SubObjectPropertyOf(:hasDirectTopping :hasTopping)
+SubObjectPropertyOf(ObjectPropertyChain(:hasPart :hasPart) :hasPart)
+TransitiveObjectProperty(:hasPart)
+ObjectPropertyDomain(:hasTopping :Pizza)
+ObjectPropertyRange(:hasTopping :Topping)
+ClassAssertion(:Pizza :myPizza)
+ObjectPropertyAssertion(:hasTopping :myPizza :t1)
+)
+"""
+
+
+def test_parse_pizza_mini():
+    onto = parser.parse(PIZZA_MINI)
+    assert onto.iri == "http://example.org/pizza"
+    kinds = [type(ax).__name__ for ax in onto.axioms]
+    assert kinds == [
+        "SubClassOf",
+        "SubClassOf",
+        "EquivalentClasses",
+        "DisjointClasses",
+        "SubObjectPropertyOf",
+        "SubObjectPropertyOf",
+        "TransitiveObjectProperty",
+        "ObjectPropertyDomain",
+        "ObjectPropertyRange",
+        "ClassAssertion",
+        "ObjectPropertyAssertion",
+    ]
+    sub = onto.axioms[1]
+    assert isinstance(sub.sup, S.ObjectSomeValuesFrom)
+    assert sub.sup.role.iri == "http://example.org/pizza#hasTopping"
+    chain_ax = onto.axioms[5]
+    assert len(chain_ax.chain) == 2
+    # declared individual recognized in assertions
+    ca = onto.axioms[9]
+    assert isinstance(ca.individual, S.Individual)
+
+
+def test_prefix_expansion_and_thing():
+    onto = parser.parse(
+        "Prefix(ex:=<http://e/>)\n"
+        "Ontology(\nSubClassOf(ex:A owl:Thing)\nSubClassOf(owl:Nothing ex:B)\n)"
+    )
+    a1, a2 = onto.axioms
+    assert a1.sub == S.Class("http://e/A")
+    assert a1.sup is S.OWL_THING
+    assert a2.sub is S.OWL_NOTHING
+
+
+def test_bare_axiom_stream():
+    onto = parser.parse("SubClassOf(A B)\nSubClassOf(B C)")
+    assert len(onto) == 2
+    assert onto.axioms[0].sub == S.Class("A")
+
+
+def test_unsupported_constructs_survive():
+    onto = parser.parse(
+        "Ontology(\n"
+        "SubClassOf(A ObjectUnionOf(B C))\n"
+        "HasKey(A () (p))\n"
+        "SubClassOf(ObjectComplementOf(A) B)\n"
+        ")"
+    )
+    assert isinstance(onto.axioms[0].sup, S.UnsupportedClassExpression)
+    assert isinstance(onto.axioms[1], S.UnsupportedAxiom)
+    assert isinstance(onto.axioms[2].sub, S.UnsupportedClassExpression)
+
+
+def test_annotations_and_declarations_skipped():
+    onto = parser.parse(
+        "Ontology(\n"
+        "Declaration(Class(A))\n"
+        'AnnotationAssertion(rdfs:label A "a label")\n'
+        "SubClassOf(Annotation(rdfs:comment \"c\") A B)\n"
+        ")"
+    )
+    assert len(onto) == 1
+    assert isinstance(onto.axioms[0], S.SubClassOf)
+
+
+def test_roundtrip_through_writer():
+    onto = parser.parse(PIZZA_MINI)
+    text = ontology_to_str(onto)
+    onto2 = parser.parse(text)
+    assert len(onto2) == len(onto)
+    assert [type(a) for a in onto2.axioms] == [type(a) for a in onto.axioms]
+
+
+def test_entity_collection():
+    onto = parser.parse(PIZZA_MINI)
+    classes = {c.iri.split("#")[-1] for c in onto.classes()}
+    assert {"Pizza", "MeatyPizza", "MeatTopping", "VegPizza"} <= classes
+    roles = {r.iri.split("#")[-1] for r in onto.roles()}
+    assert {"hasTopping", "hasPart", "hasDirectTopping"} <= roles
+    inds = {i.iri.split("#")[-1] for i in onto.individuals()}
+    assert {"myPizza", "t1"} <= inds
+
+
+def test_nested_intersections():
+    onto = parser.parse(
+        "SubClassOf(A ObjectIntersectionOf(B ObjectSomeValuesFrom(r "
+        "ObjectIntersectionOf(C D)) E))"
+    )
+    sup = onto.axioms[0].sup
+    assert isinstance(sup, S.ObjectIntersectionOf)
+    assert len(sup.operands) == 3
+    some = sup.operands[1]
+    assert isinstance(some.filler, S.ObjectIntersectionOf)
