@@ -1,0 +1,1 @@
+"""Frontend passes: normalization, profile checking, corpus tools."""

@@ -1,0 +1,178 @@
+from distel_tpu.frontend.normalizer import Normalizer, normalize, GENSYM_PREFIX
+from distel_tpu.owl import parser, syntax as S
+
+
+def norm(text: str):
+    return normalize(parser.parse(text))
+
+
+def atoms_iri(pairs):
+    return {(a.iri, b.iri) for a, b in pairs}
+
+
+def test_nf1_passthrough():
+    n = norm("SubClassOf(A B)")
+    assert atoms_iri(n.nf1) == {("A", "B")}
+    assert n.axiom_count() == 1
+
+
+def test_equivalent_classes_cycle():
+    n = norm("EquivalentClasses(A B C)")
+    assert atoms_iri(n.nf1) == {("A", "B"), ("B", "C"), ("C", "A")}
+
+
+def test_disjoint_to_bottom():
+    n = norm("DisjointClasses(A B)")
+    assert len(n.nf2) == 1
+    ops, b = n.nf2[0]
+    assert {o.iri for o in ops} == {"A", "B"}
+    assert b is S.OWL_NOTHING
+
+
+def test_nary_conjunction_kept():
+    n = norm("SubClassOf(ObjectIntersectionOf(A B C) D)")
+    assert len(n.nf2) == 1
+    ops, d = n.nf2[0]
+    assert len(ops) == 3 and d.iri == "D"
+
+
+def test_complex_conjunct_flattened():
+    # (A ⊓ ∃r.B) ⊑ D  →  ∃r.B ⊑ X, A ⊓ X ⊑ D
+    n = norm("SubClassOf(ObjectIntersectionOf(A ObjectSomeValuesFrom(r B)) D)")
+    assert len(n.nf2) == 1 and len(n.nf4) == 1
+    r, a, x = n.nf4[0]
+    assert r.iri == "r" and a.iri == "B" and x.iri.startswith(GENSYM_PREFIX)
+
+
+def test_rhs_existential_complex_filler():
+    # A ⊑ ∃r.(B ⊓ C)  →  A ⊑ ∃r.X, X ⊑ B, X ⊑ C
+    n = norm("SubClassOf(A ObjectSomeValuesFrom(r ObjectIntersectionOf(B C)))")
+    assert len(n.nf3) == 1
+    a, r, x = n.nf3[0]
+    assert x.iri.startswith(GENSYM_PREFIX)
+    assert atoms_iri(n.nf1) == {(x.iri, "B"), (x.iri, "C")}
+
+
+def test_lhs_existential_nested():
+    # ∃r.(∃s.A) ⊑ B  →  ∃s.A ⊑ X, ∃r.X ⊑ B
+    n = norm("SubClassOf(ObjectSomeValuesFrom(r ObjectSomeValuesFrom(s A)) B)")
+    assert len(n.nf4) == 2
+
+
+def test_both_sides_complex():
+    # ∃r.A ⊑ ∃s.B  →  ∃r.A ⊑ X, X ⊑ ∃s.B
+    n = norm(
+        "SubClassOf(ObjectSomeValuesFrom(r A) ObjectSomeValuesFrom(s B))"
+    )
+    assert len(n.nf4) == 1 and len(n.nf3) == 1
+    assert n.nf4[0][2] == n.nf3[0][0]
+
+
+def test_rhs_conjunction_split():
+    n = norm("SubClassOf(A ObjectIntersectionOf(B C))")
+    assert atoms_iri(n.nf1) == {("A", "B"), ("A", "C")}
+
+
+def test_transitivity_and_chains():
+    n = norm(
+        "TransitiveObjectProperty(p)\n"
+        "SubObjectPropertyOf(ObjectPropertyChain(q r s) t)\n"
+        "SubObjectPropertyOf(u v)"
+    )
+    assert len(n.nf6) == 3  # p∘p⊑p + split 3-chain into 2
+    assert len(n.nf5) == 1
+    chain_roles = [(a.iri, b.iri, c.iri) for a, b, c in n.nf6]
+    assert ("p", "p", "p") in chain_roles
+
+
+def test_domain_becomes_nf4():
+    n = norm("ObjectPropertyDomain(r D)")
+    assert len(n.nf4) == 1
+    r, a, d = n.nf4[0]
+    assert a is S.OWL_THING and d.iri == "D"
+
+
+def test_range_elimination():
+    n = norm("ObjectPropertyRange(r D)\nSubClassOf(A ObjectSomeValuesFrom(r B))")
+    assert len(n.nf3) == 1
+    a, r, x = n.nf3[0]
+    assert x.iri.startswith(GENSYM_PREFIX)
+    assert ("D" in {b.iri for _, b in n.nf1}) and (x.iri, "B") in atoms_iri(n.nf1)
+
+
+def test_range_through_superrole():
+    n = norm(
+        "ObjectPropertyRange(s D)\nSubObjectPropertyOf(r s)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))"
+    )
+    a, r, x = n.nf3[0]
+    assert x.iri.startswith(GENSYM_PREFIX)
+    assert (x.iri, "D") in atoms_iri(n.nf1)
+
+
+def test_range_memoized_per_filler():
+    n = norm(
+        "ObjectPropertyRange(r D)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(C ObjectSomeValuesFrom(r B))"
+    )
+    assert n.nf3[0][2] == n.nf3[1][2]  # same gensym reused
+
+
+def test_abox_conversion():
+    n = norm(
+        "Ontology(\nDeclaration(NamedIndividual(a))\nDeclaration(NamedIndividual(b))\n"
+        "ClassAssertion(C a)\nObjectPropertyAssertion(r a b)\n)"
+    )
+    assert len(n.nf1) == 1 and isinstance(n.nf1[0][0], S.Individual)
+    assert len(n.nf3) == 1
+    sub, r, obj = n.nf3[0]
+    assert isinstance(sub, S.Individual) and isinstance(obj, S.Individual)
+
+
+def test_unsupported_dropped_and_counted():
+    n = norm("SubClassOf(A ObjectUnionOf(B C))\nHasKey(A () (p))")
+    assert n.axiom_count() == 0
+    assert sum(n.removed.values()) == 2
+
+
+def test_trivial_axioms_dropped():
+    n = norm(
+        "SubClassOf(owl:Nothing A)\nSubClassOf(A owl:Thing)\n"
+        "SubClassOf(ObjectSomeValuesFrom(r owl:Nothing) B)"
+    )
+    assert n.axiom_count() == 0
+
+
+def test_exists_bottom_rhs_forces_unsat():
+    n = norm("SubClassOf(A ObjectSomeValuesFrom(r owl:Nothing))")
+    assert len(n.nf1) == 1
+    a, b = n.nf1[0]
+    assert a.iri == "A" and b is S.OWL_NOTHING
+
+
+def test_gensym_memoization_shared():
+    # same complex expression used twice on LHS → one gensym
+    n = norm(
+        "SubClassOf(ObjectSomeValuesFrom(r A) B)\n"
+        "SubClassOf(ObjectSomeValuesFrom(r A) C)"
+    )
+    assert len(n.nf4) == 1 or (
+        len(n.nf4) == 2 and n.nf4[0][:2] == n.nf4[1][:2]
+    )
+
+
+def test_cache_roundtrip():
+    text = "SubClassOf(A ObjectSomeValuesFrom(r ObjectIntersectionOf(B C)))"
+    n1 = Normalizer()
+    n1.normalize(parser.parse(text))
+    cache = n1.export_cache()
+    n2 = Normalizer(cache=cache)
+    out2 = n2.normalize(parser.parse(text))
+    # incremental re-run reuses the same gensym names
+    assert n1.out.nf3[0][2] == out2.nf3[0][2]
+
+
+def test_top_lhs():
+    n = norm("SubClassOf(owl:Thing A)")
+    assert len(n.nf1) == 1 and n.nf1[0][0] is S.OWL_THING
