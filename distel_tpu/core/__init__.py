@@ -1,0 +1,1 @@
+"""Core: indexing, the CPU oracle, and the TPU saturation engine."""

@@ -1,0 +1,272 @@
+"""Indexing: normalized IR → dense integer tensors for the TPU engine.
+
+The analog of the reference's load plane (``init/AxiomLoader.java``):
+  * every entity gets a dense int id (replacing the packed string ids of
+    ``misc/Util.java:95-103``), with ⊥=0 / ⊤=1 exactly like the reference's
+    ``BOTTOM_ID=0 / TOP_ID=1`` (``misc/Constants.java:30-31``);
+  * axioms are categorized by normal form into flat numpy arrays (replacing
+    the per-rule Redis shard layout of ``init/AxiomLoader.java:495-577``);
+  * n-ary conjunctions are binarized with shared auxiliary concepts so CR2
+    becomes a fixed-arity column AND (the reference instead runs an n-way
+    ZINTERSTORE Lua, ``base/Type1_2AxiomProcessorBase.java:45-66``).
+
+TPU-first representation — the **link table**: during EL+ saturation every
+role pair (X,Y) ∈ R(r) has Y drawn from the finite set of existential
+fillers, so instead of per-role boolean matrices ``R[r][X,Y]`` (the naive
+translation of the reference's ``Yr → {X}`` key layout,
+``RolePairHandler.java:396-444``) we materialize the set of *links*
+L = {(r, B)} closed under role-chain targets, and keep one boolean matrix
+``R[x, l]``.  All rule applications then become column gathers/scatters or
+matmuls over the link axis (see ``core/engine.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from distel_tpu.frontend.normalizer import NormalizedOntology
+from distel_tpu.owl import syntax as S
+
+BOTTOM_ID = 0  # reference misc/Constants.java:31
+TOP_ID = 1     # reference misc/Constants.java:30
+
+AUX_PREFIX = "distel:aux#"
+
+
+def atom_key(atom: S.ClassExpression) -> str:
+    if atom is S.OWL_THING or atom == S.OWL_THING:
+        return "owl:Thing"
+    if atom is S.OWL_NOTHING or atom == S.OWL_NOTHING:
+        return "owl:Nothing"
+    if isinstance(atom, S.Individual):
+        return f"ind:{atom.iri}"
+    return atom.iri
+
+
+@dataclass
+class IndexedOntology:
+    """Flat tensor form of a normalized ontology.
+
+    Array conventions (all int32):
+      nf1        [K1, 2]  rows (a, b)          : a ⊑ b
+      nf2        [K2, 3]  rows (a1, a2, b)     : a1 ⊓ a2 ⊑ b (binarized)
+      nf3        [K3, 2]  rows (a, l)          : a ⊑ ∃role(l).filler(l)
+      nf4        [K4, 3]  rows (s, a, b)       : ∃s.a ⊑ b
+      links      [L, 2]   rows (role, filler)
+      chain_pairs[P, 3]   rows (r_first, l2, lt): precomputed second-leg
+                 expansion of every chain axiom r∘s⊑t — for a link l2 whose
+                 role ⊑* s, a pair over l2 starting at filler(l1) extends any
+                 l1-pair (role(l1) ⊑* r_first) to the link lt=(t, filler(l2)).
+      role_closure [Nr, Nr] bool: H[r, s] = r ⊑* s (reflexive-transitive)
+    """
+
+    n_concepts: int
+    n_roles: int
+    concept_names: List[str]
+    concept_ids: Dict[str, int]
+    role_names: List[str]
+    role_ids: Dict[str, int]
+    nf1: np.ndarray
+    nf2: np.ndarray
+    nf3: np.ndarray
+    nf4: np.ndarray
+    links: np.ndarray
+    chain_pairs: np.ndarray
+    role_closure: np.ndarray
+    #: ids of original (non-gensym, non-aux) named classes — the signature
+    #: the taxonomy/export layer projects onto
+    original_classes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    has_bottom_axioms: bool = False
+
+    @property
+    def n_links(self) -> int:
+        return len(self.links)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "concepts": self.n_concepts,
+            "roles": self.n_roles,
+            "links": self.n_links,
+            "nf1": len(self.nf1),
+            "nf2": len(self.nf2),
+            "nf3": len(self.nf3),
+            "nf4": len(self.nf4),
+            "chain_pairs": len(self.chain_pairs),
+        }
+
+
+class Indexer:
+    """Entity interning + axiom tensorization.
+
+    An Indexer instance is *persistent*: calling ``index`` again with a
+    grown axiom set keeps every previously-assigned concept/role/link id
+    stable — the property incremental classification relies on to embed a
+    saturated S/R state into the larger arrays (the reference's analog is
+    the Redis stores simply persisting across ``CURRENT_INCREMENT`` bumps,
+    ``init/AxiomLoader.java:119-129``).
+    """
+
+    def __init__(self) -> None:
+        self.concept_ids: Dict[str, int] = {"owl:Nothing": BOTTOM_ID, "owl:Thing": TOP_ID}
+        self.concept_names: List[str] = ["owl:Nothing", "owl:Thing"]
+        self.role_ids: Dict[str, int] = {}
+        self.role_names: List[str] = []
+        self.link_ids: Dict[Tuple[int, int], int] = {}
+        self.link_rows: List[Tuple[int, int]] = []
+        self._aux_counter = 0
+        self._aux_memo: Dict[Tuple[int, int], int] = {}
+
+    def concept(self, atom: S.ClassExpression) -> int:
+        k = atom_key(atom)
+        cid = self.concept_ids.get(k)
+        if cid is None:
+            cid = len(self.concept_names)
+            self.concept_ids[k] = cid
+            self.concept_names.append(k)
+        return cid
+
+    def role(self, r: S.ObjectProperty) -> int:
+        rid = self.role_ids.get(r.iri)
+        if rid is None:
+            rid = len(self.role_names)
+            self.role_ids[r.iri] = rid
+            self.role_names.append(r.iri)
+        return rid
+
+    def _aux_concept(self, a1: int, a2: int) -> int:
+        """Shared auxiliary concept for binarization: aux ⊒ a1 ⊓ a2."""
+        key = (a1, a2) if a1 <= a2 else (a2, a1)
+        cid = self._aux_memo.get(key)
+        if cid is None:
+            cid = len(self.concept_names)
+            name = f"{AUX_PREFIX}{self._aux_counter}"
+            self._aux_counter += 1
+            self.concept_ids[name] = cid
+            self.concept_names.append(name)
+            self._aux_memo[key] = cid
+        return cid
+
+    def index(self, norm: NormalizedOntology) -> IndexedOntology:
+        # intern all atoms first so original classes get stable low ids
+        for atom in sorted(norm.atoms(), key=atom_key):
+            self.concept(atom)
+        for r in sorted(norm.roles(), key=lambda r: r.iri):
+            self.role(r)
+
+        nf1_rows: List[Tuple[int, int]] = []
+        nf2_rows: List[Tuple[int, int, int]] = []
+        nf3_rows: List[Tuple[int, int]] = []  # (a, link)
+        nf4_rows: List[Tuple[int, int, int]] = []
+        nf5_rows: List[Tuple[int, int]] = []
+        nf6_rows: List[Tuple[int, int, int]] = []
+
+        for a, b in norm.nf1:
+            nf1_rows.append((self.concept(a), self.concept(b)))
+        for ops, b in norm.nf2:
+            ids = [self.concept(o) for o in ops]
+            # left-fold with shared aux concepts: a1⊓a2⊑x12, x12⊓a3⊑x123, ...
+            acc = ids[0]
+            for i in range(1, len(ids) - 1):
+                aux = self._aux_concept(acc, ids[i])
+                nf2_rows.append((acc, ids[i], aux))
+                acc = aux
+            nf2_rows.append((acc, ids[-1], self.concept(b)))
+        for r, s in norm.nf5:
+            nf5_rows.append((self.role(r), self.role(s)))
+        for r, s, t in norm.nf6:
+            nf6_rows.append((self.role(r), self.role(s), self.role(t)))
+
+        n_roles = len(self.role_names)
+        closure = _role_closure(n_roles, nf5_rows)
+
+        # link table: distinct (role, filler) from NF3, then closed under
+        # chain targets (t, filler(l2)) — the finite universe of R-columns.
+        link_ids = self.link_ids
+        links = self.link_rows
+
+        def link(r: int, f: int) -> int:
+            lid = link_ids.get((r, f))
+            if lid is None:
+                lid = len(links)
+                link_ids[(r, f)] = lid
+                links.append((r, f))
+            return lid
+
+        for a, r, b in norm.nf3:
+            nf3_rows.append((self.concept(a), link(self.role(r), self.concept(b))))
+
+        # close links under chain heads; compute chain_pairs
+        chain_pairs: List[Tuple[int, int, int]] = []
+        if nf6_rows:
+            seen_pairs = set()
+            changed = True
+            while changed:
+                changed = False
+                for (r, s, t) in nf6_rows:
+                    # snapshot: links may grow while iterating
+                    for l2 in range(len(links)):
+                        r2, f2 = links[l2]
+                        if not closure[r2, s]:
+                            continue
+                        lt = link(t, f2)
+                        key2 = (r, l2, lt)
+                        if key2 not in seen_pairs:
+                            seen_pairs.add(key2)
+                            chain_pairs.append(key2)
+                            changed = True
+
+        for r, a, b in norm.nf4:
+            nf4_rows.append((self.role(r), self.concept(a), self.concept(b)))
+
+        n_concepts = len(self.concept_names)
+        original = [
+            i
+            for i, name in enumerate(self.concept_names)
+            if not name.startswith(("distel:gensym#", AUX_PREFIX, "ind:"))
+        ]
+
+        has_bottom = any(b == BOTTOM_ID for _, b in nf1_rows) or any(
+            b == BOTTOM_ID for _, _, b in nf2_rows
+        ) or any(b == BOTTOM_ID for _, _, b in nf4_rows)
+
+        def arr(rows, width):
+            if not rows:
+                return np.zeros((0, width), np.int32)
+            return np.asarray(rows, np.int32)
+
+        return IndexedOntology(
+            n_concepts=n_concepts,
+            n_roles=max(n_roles, 1),
+            concept_names=self.concept_names,
+            concept_ids=self.concept_ids,
+            role_names=self.role_names,
+            role_ids=self.role_ids,
+            nf1=arr(nf1_rows, 2),
+            nf2=arr(nf2_rows, 3),
+            nf3=arr(nf3_rows, 2),
+            nf4=arr(nf4_rows, 3),
+            links=arr(links, 2),
+            chain_pairs=arr(chain_pairs, 3),
+            role_closure=closure,
+            original_classes=np.asarray(original, np.int32),
+            has_bottom_axioms=has_bottom,
+        )
+
+
+def _role_closure(n_roles: int, edges: List[Tuple[int, int]]) -> np.ndarray:
+    """Reflexive-transitive closure H[r, s] = r ⊑* s via boolean Warshall
+    (Nr is small: SNOMED has ~60 roles)."""
+    n = max(n_roles, 1)
+    h = np.eye(n, dtype=bool)
+    for r, s in edges:
+        h[r, s] = True
+    for k in range(n):
+        h |= np.outer(h[:, k], h[k, :])
+    return h
+
+
+def index_ontology(norm: NormalizedOntology) -> IndexedOntology:
+    return Indexer().index(norm)

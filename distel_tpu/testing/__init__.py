@@ -1,0 +1,1 @@
+"""Verification harnesses: oracle differential testing, taxonomy export."""

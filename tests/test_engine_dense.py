@@ -1,0 +1,191 @@
+"""Differential tests: TPU engine vs CPU oracle on the same ontologies.
+
+Every scenario from test_oracle.py runs through the full pipeline
+(parse → normalize → index → jitted saturation) and must produce subsumer
+sets identical to the independent Python oracle — the unit-test layer the
+reference lacked (SURVEY.md §4), plus randomized EL+ ontologies as a
+property test.
+"""
+
+import random
+
+import pytest
+
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.engine import SaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.owl import parser, syntax as S
+from distel_tpu.testing.differential import classify_and_diff
+
+SCENARIOS = {
+    "hierarchy": "SubClassOf(A B)\nSubClassOf(B C)\nSubClassOf(C D)",
+    "conjunction": (
+        "SubClassOf(A B)\nSubClassOf(A C)\n"
+        "SubClassOf(ObjectIntersectionOf(B C) D)"
+    ),
+    "nary_conjunction": (
+        "SubClassOf(A B)\nSubClassOf(A C)\nSubClassOf(A E)\n"
+        "SubClassOf(ObjectIntersectionOf(B C E) D)\n"
+        "SubClassOf(ObjectIntersectionOf(B C) F)"
+    ),
+    "existential": (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B C)\n"
+        "SubClassOf(ObjectSomeValuesFrom(r C) D)"
+    ),
+    "role_hierarchy": (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubObjectPropertyOf(r s)\n"
+        "SubClassOf(ObjectSomeValuesFrom(s B) D)"
+    ),
+    "transitive": (
+        "TransitiveObjectProperty(p)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(p B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(p D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(p D) E)"
+    ),
+    "complex_chain": (
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) t)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(s D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(t D) E)"
+    ),
+    "long_chain": (
+        "SubObjectPropertyOf(ObjectPropertyChain(r s u) t)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(s BB))\n"
+        "SubClassOf(BB ObjectSomeValuesFrom(u D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(t D) E)"
+    ),
+    "bottom": (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\nSubClassOf(B owl:Nothing)"
+    ),
+    "disjoint": "DisjointClasses(B D)\nSubClassOf(A B)\nSubClassOf(A D)",
+    "domain_range": (
+        "ObjectPropertyDomain(r D)\nObjectPropertyRange(r E)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r E) F)"
+    ),
+    "equivalence": "EquivalentClasses(A B)\nSubClassOf(B D)",
+    "abox": (
+        "Ontology(\nDeclaration(NamedIndividual(a))\n"
+        "Declaration(NamedIndividual(b))\n"
+        "ClassAssertion(D a)\nObjectPropertyAssertion(r a b)\n"
+        "SubClassOf(ObjectSomeValuesFrom(r owl:Thing) E)\n)"
+    ),
+    "top_axiom": "SubClassOf(owl:Thing A)\nSubClassOf(B D)",
+    "nested_filler": (
+        "SubClassOf(A ObjectSomeValuesFrom(r ObjectIntersectionOf(B C)))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r B) D)"
+    ),
+    "chain_then_hierarchy": (
+        # pairs produced by a chain feed a super-role consumer
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) t)\n"
+        "SubObjectPropertyOf(t u)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(s D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(u D) E)"
+    ),
+    "hierarchy_then_chain": (
+        # pairs entering a chain through sub-roles on both legs
+        "SubObjectPropertyOf(r1 r)\nSubObjectPropertyOf(s1 s)\n"
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) t)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r1 B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(s1 D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(t D) E)"
+    ),
+    "chain_of_chain": (
+        # output of one chain is the input of another
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) t)\n"
+        "SubObjectPropertyOf(ObjectPropertyChain(t s) v)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(s D))\n"
+        "SubClassOf(D ObjectSomeValuesFrom(s F))\n"
+        "SubClassOf(ObjectSomeValuesFrom(v F) E)"
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_engine_matches_oracle(name):
+    norm = normalize(parser.parse(SCENARIOS[name]))
+    result, report = classify_and_diff(norm)
+    assert report.ok(), f"{name}: {report.summary()}"
+
+
+def test_specific_entailments():
+    norm = normalize(parser.parse(SCENARIOS["complex_chain"]))
+    idx = index_ontology(norm)
+    result = SaturationEngine(idx).saturate()
+    a = idx.concept_ids["A"]
+    e = idx.concept_ids["E"]
+    b = idx.concept_ids["B"]
+    assert result.s[a, e]
+    assert not result.s[b, e]
+    assert result.iterations >= 2
+
+
+def test_unsat_detection():
+    norm = normalize(parser.parse(SCENARIOS["disjoint"]))
+    idx = index_ontology(norm)
+    result = SaturationEngine(idx).saturate()
+    assert idx.concept_ids["A"] in result.unsatisfiable()
+    assert idx.concept_ids["B"] not in result.unsatisfiable()
+
+
+def _random_ontology(rng: random.Random, n_classes=14, n_roles=3, n_axioms=28) -> str:
+    """Random EL+ ontology generator for property testing."""
+    classes = [f"C{i}" for i in range(n_classes)]
+    roles = [f"r{i}" for i in range(n_roles)]
+    lines = []
+
+    def cls():
+        return rng.choice(classes + ["owl:Thing"])
+
+    def expr(depth=0):
+        kind = rng.random()
+        if depth >= 2 or kind < 0.45:
+            return cls()
+        if kind < 0.75:
+            return f"ObjectSomeValuesFrom({rng.choice(roles)} {expr(depth + 1)})"
+        ops = " ".join(expr(depth + 1) for _ in range(rng.randint(2, 3)))
+        return f"ObjectIntersectionOf({ops})"
+
+    for _ in range(n_axioms):
+        k = rng.random()
+        if k < 0.6:
+            lines.append(f"SubClassOf({expr()} {expr()})")
+        elif k < 0.7:
+            lines.append(f"EquivalentClasses({cls()} {expr()})")
+        elif k < 0.78:
+            r1, r2 = rng.choice(roles), rng.choice(roles)
+            lines.append(f"SubObjectPropertyOf({r1} {r2})")
+        elif k < 0.86:
+            r1, r2, r3 = (rng.choice(roles) for _ in range(3))
+            lines.append(
+                f"SubObjectPropertyOf(ObjectPropertyChain({r1} {r2}) {r3})"
+            )
+        elif k < 0.92:
+            lines.append(f"ObjectPropertyDomain({rng.choice(roles)} {cls()})")
+        else:
+            lines.append(f"ObjectPropertyRange({rng.choice(roles)} {cls()})")
+    return "\n".join(lines)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_ontologies_match_oracle(seed):
+    rng = random.Random(seed * 7919 + 13)
+    text = _random_ontology(rng)
+    norm = normalize(parser.parse(text))
+    result, report = classify_and_diff(norm)
+    assert report.ok(), f"seed {seed}:\n{text}\n{report.summary()}"
+
+
+@pytest.mark.parametrize("seed", [100, 101])
+def test_random_with_bottom(seed):
+    rng = random.Random(seed)
+    text = _random_ontology(rng, n_axioms=20)
+    text += "\nDisjointClasses(C0 C1)\nSubClassOf(C2 C0)\nSubClassOf(C2 C1)"
+    norm = normalize(parser.parse(text))
+    result, report = classify_and_diff(norm)
+    assert report.ok(), f"seed {seed}:\n{text}\n{report.summary()}"

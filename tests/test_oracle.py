@@ -1,0 +1,131 @@
+"""Oracle sanity on hand-computed ontologies (the semantics spec)."""
+
+from distel_tpu.core.oracle import saturate
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.owl import parser, syntax as S
+
+
+def sat(text: str):
+    return saturate(normalize(parser.parse(text)))
+
+
+def C(x):
+    return S.Class(x)
+
+
+def test_transitive_hierarchy():
+    r = sat("SubClassOf(A B)\nSubClassOf(B C)\nSubClassOf(C D)")
+    assert r.is_subsumed(C("A"), C("D"))
+    assert r.is_subsumed(C("B"), C("D"))
+    assert not r.is_subsumed(C("D"), C("A"))
+    assert r.is_subsumed(C("A"), S.OWL_THING)
+    assert r.is_subsumed(C("A"), C("A"))
+
+
+def test_conjunction():
+    r = sat(
+        "SubClassOf(A B)\nSubClassOf(A C)\n"
+        "SubClassOf(ObjectIntersectionOf(B C) D)"
+    )
+    assert r.is_subsumed(C("A"), C("D"))
+    assert not r.is_subsumed(C("B"), C("D"))
+
+
+def test_existential_propagation():
+    # A ⊑ ∃r.B, B ⊑ C, ∃r.C ⊑ D  ⟹  A ⊑ D
+    r = sat(
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B C)\n"
+        "SubClassOf(ObjectSomeValuesFrom(r C) D)"
+    )
+    assert r.is_subsumed(C("A"), C("D"))
+
+
+def test_role_hierarchy():
+    # A ⊑ ∃r.B, r ⊑ s, ∃s.B ⊑ D  ⟹  A ⊑ D
+    r = sat(
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubObjectPropertyOf(r s)\n"
+        "SubClassOf(ObjectSomeValuesFrom(s B) D)"
+    )
+    assert r.is_subsumed(C("A"), C("D"))
+
+
+def test_role_chain_transitivity():
+    # part-of transitive: A ⊑ ∃p.B, B ⊑ ∃p.D, ∃p.D ⊑ E ⟹ A ⊑ E via p∘p⊑p
+    r = sat(
+        "TransitiveObjectProperty(p)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(p B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(p D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(p D) E)"
+    )
+    assert r.is_subsumed(C("A"), C("E"))
+    assert r.is_subsumed(C("B"), C("E"))
+
+
+def test_complex_chain():
+    # r∘s⊑t: A ⊑ ∃r.B, B ⊑ ∃s.D, ∃t.D ⊑ E ⟹ A ⊑ E
+    r = sat(
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) t)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(s D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(t D) E)"
+    )
+    assert r.is_subsumed(C("A"), C("E"))
+    assert not r.is_subsumed(C("B"), C("E"))
+
+
+def test_bottom_propagation():
+    # A ⊑ ∃r.B, B ⊑ ⊥ ⟹ A ⊑ ⊥ (CR5)
+    r = sat(
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B owl:Nothing)"
+    )
+    assert r.is_subsumed(C("A"), S.OWL_NOTHING)
+    assert {a for a in r.unsatisfiable() if isinstance(a, S.Class)} >= {
+        C("A"),
+        C("B"),
+    }
+
+
+def test_disjointness_unsat():
+    r = sat(
+        "DisjointClasses(B D)\nSubClassOf(A B)\nSubClassOf(A D)"
+    )
+    assert r.is_subsumed(C("A"), S.OWL_NOTHING)
+    assert not r.is_subsumed(C("B"), S.OWL_NOTHING)
+
+
+def test_domain_range():
+    r = sat(
+        "ObjectPropertyDomain(r D)\n"
+        "ObjectPropertyRange(r E)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r E) F)"
+    )
+    assert r.is_subsumed(C("A"), C("D"))  # domain
+    assert r.is_subsumed(C("A"), C("F"))  # range makes the filler an E
+
+
+def test_equivalence_cycle():
+    r = sat("EquivalentClasses(A B)\nSubClassOf(B D)")
+    assert r.is_subsumed(C("A"), C("D"))
+    assert r.is_subsumed(C("A"), C("B")) and r.is_subsumed(C("B"), C("A"))
+
+
+def test_abox_subsumption():
+    r = sat(
+        "Ontology(\nDeclaration(NamedIndividual(a))\nDeclaration(NamedIndividual(b))\n"
+        "ClassAssertion(D a)\nObjectPropertyAssertion(r a b)\n"
+        "SubClassOf(ObjectSomeValuesFrom(r owl:Thing) E)\n)"
+    )
+    ind_a = S.Individual("a")
+    assert r.is_subsumed(ind_a, C("D"))
+    assert r.is_subsumed(ind_a, C("E"))
+
+
+def test_top_axiom():
+    r = sat("SubClassOf(owl:Thing A)\nSubClassOf(B D)")
+    assert r.is_subsumed(C("B"), C("A"))
+    assert r.is_subsumed(C("D"), C("A"))
+    assert r.is_subsumed(C("A"), C("A"))
