@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Benchmark driver: EL+ saturation throughput on the attached accelerator.
+
+Prints ONE JSON line:
+  {"metric": "axiom_derivations_per_sec", "value": N, "unit": "derivations/s",
+   "vs_baseline": R, ...}
+
+``vs_baseline`` is the speedup over the single-threaded CPU reference
+saturation (``distel_tpu/core/oracle.py``) on the *same* corpus — the
+stand-in for the reference system's throughput, since the reference
+repository publishes no benchmark numbers (BASELINE.md: "published: {}").
+
+Corpus: deterministic GALEN-shaped synthetic EL+ ontology exercising all
+of CR1-CR6 (hierarchy, n-ary conjunctions, existentials, role hierarchy,
+transitive partonomy, right-identity chain, domain/range).
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from distel_tpu.owl import parser  # noqa: E402
+from distel_tpu.frontend.normalizer import normalize  # noqa: E402
+from distel_tpu.frontend.ontology_tools import synthetic_ontology  # noqa: E402
+from distel_tpu.core.indexing import index_ontology  # noqa: E402
+from distel_tpu.core.engine import SaturationEngine  # noqa: E402
+from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 4000
+    text = synthetic_ontology(
+        n_classes=n_classes,
+        n_anatomy=max(200, n_classes // 10),
+        n_locations=max(150, n_classes // 12),
+        n_definitions=max(100, n_classes // 20),
+    )
+    norm = normalize(parser.parse(text))
+    idx = index_ontology(norm)
+
+    engine = SaturationEngine(idx)
+    # cold run = compile + execute; warm run is the steady-state number
+    t0 = time.time()
+    result = engine.saturate()
+    cold_s = time.time() - t0
+    t0 = time.time()
+    result = engine.saturate()
+    warm_s = time.time() - t0
+    engine_dps = result.derivations / warm_s
+
+    # CPU reference baseline on the same corpus
+    t0 = time.time()
+    oracle_result = cpu_oracle.saturate(norm)
+    oracle_s = time.time() - t0
+    oracle_dps = oracle_result.derivation_count() / oracle_s
+
+    print(
+        json.dumps(
+            {
+                "metric": "axiom_derivations_per_sec",
+                "value": round(engine_dps, 1),
+                "unit": "derivations/s",
+                "vs_baseline": round(engine_dps / oracle_dps, 2),
+                "platform": jax.devices()[0].platform,
+                "n_concepts": idx.n_concepts,
+                "n_links": idx.n_links,
+                "derivations": result.derivations,
+                "iterations": result.iterations,
+                "wall_s_warm": round(warm_s, 3),
+                "wall_s_cold": round(cold_s, 3),
+                "baseline_cpu_dps": round(oracle_dps, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
