@@ -1,0 +1,200 @@
+"""Command-line interface — the ops layer the reference spreads over
+``scripts/*.sh`` (SURVEY.md §2.7), collapsed into subcommands:
+
+  classify   run-all.sh / classifier.sh  (load → saturate → taxonomy)
+  normalize  Normalizer standalone main  (init/Normalizer.java:896-943)
+  stats      OntologyStats / DataStats census
+  check      ProfileChecker report
+  multiply   OntologyMultiplier synthetic scaling
+  diff       test-classify.sh oracle-diff verification
+  bench      run-all.sh timing loop
+
+Usage: python -m distel_tpu.cli <subcommand> [args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def cmd_classify(args) -> int:
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.classifier import ELClassifier
+
+    cfg = (
+        ClassifierConfig.from_properties(args.config)
+        if args.config
+        else ClassifierConfig()
+    )
+    if args.mesh:
+        cfg.mesh_devices = args.mesh
+    cfg.instrumentation = args.instrument
+    clf = ELClassifier(cfg)
+    res = clf.classify_file(args.ontology, verify=args.verify)
+    print(json.dumps(res.summary(), indent=2))
+    if args.output:
+        res.taxonomy.write(args.output)
+        print(f"taxonomy written to {args.output}")
+    if args.snapshot:
+        from distel_tpu.runtime.checkpoint import save_snapshot
+
+        save_snapshot(args.snapshot, res.result)
+        print(f"snapshot written to {args.snapshot}")
+    return 0
+
+
+def cmd_normalize(args) -> int:
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+
+    norm = normalize(parser.parse_file(args.ontology))
+    out = sys.stdout if not args.output else open(args.output, "w")
+    try:
+        for a, b in norm.nf1:
+            out.write(f"NF1 {a!r} ⊑ {b!r}\n")
+        for ops, b in norm.nf2:
+            out.write(f"NF2 {' ⊓ '.join(map(repr, ops))} ⊑ {b!r}\n")
+        for a, r, b in norm.nf3:
+            out.write(f"NF3 {a!r} ⊑ ∃{r.iri}.{b!r}\n")
+        for r, a, b in norm.nf4:
+            out.write(f"NF4 ∃{r.iri}.{a!r} ⊑ {b!r}\n")
+        for r, s in norm.nf5:
+            out.write(f"NF5 {r.iri} ⊑ {s.iri}\n")
+        for r, s, t in norm.nf6:
+            out.write(f"NF6 {r.iri} ∘ {s.iri} ⊑ {t.iri}\n")
+    finally:
+        if args.output:
+            out.close()
+    print(
+        f"# normalized: {norm.axiom_count()} axioms, "
+        f"{len(norm.gensyms)} gensyms, removed: {dict(norm.removed)}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_stats(args) -> int:
+    from distel_tpu.runtime.stats import ontology_stats
+
+    print(json.dumps(ontology_stats(args.ontology), indent=2))
+    return 0
+
+
+def cmd_check(args) -> int:
+    from distel_tpu.frontend.profile_checker import check_profile
+    from distel_tpu.owl import parser
+
+    kept, removed = check_profile(parser.parse_file(args.ontology))
+    print(json.dumps({"in_profile": kept, "removed": dict(removed)}, indent=2))
+    return 0 if not removed else 1
+
+
+def cmd_multiply(args) -> int:
+    from distel_tpu.frontend.ontology_tools import multiply_ontology
+    from distel_tpu.owl import parser
+    from distel_tpu.owl.writer import write_file
+
+    onto = parser.parse_file(args.ontology)
+    out = multiply_ontology(onto, args.n, crossed=args.crossed)
+    write_file(out, args.output)
+    print(f"{len(out)} axioms written to {args.output}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+    from distel_tpu.testing.differential import classify_and_diff
+
+    norm = normalize(parser.parse_file(args.ontology))
+    _, report = classify_and_diff(norm)
+    print(report.summary())
+    return 0 if report.ok() else 1
+
+
+def cmd_bench(args) -> int:
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.engine import SaturationEngine
+
+    norm = normalize(parser.parse_file(args.ontology))
+    idx = index_ontology(norm)
+    engine = SaturationEngine(idx)
+    times = []
+    for i in range(args.repeats + 1):
+        t0 = time.time()
+        result = engine.saturate()
+        dt = time.time() - t0
+        times.append(dt)
+        print(
+            f"run {i}: {dt:.3f}s {'(cold)' if i == 0 else ''} "
+            f"iters={result.iterations} derivations={result.derivations}",
+            file=sys.stderr,
+        )
+    warm = times[1:] or times
+    print(
+        json.dumps(
+            {
+                "metric": "wall_s_to_fixed_point",
+                "value": round(min(warm), 4),
+                "unit": "s",
+                "runs": [round(t, 4) for t in times],
+            }
+        )
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="distel_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("classify", help="classify an ontology")
+    c.add_argument("ontology")
+    c.add_argument("--config", help="properties/config file")
+    c.add_argument("--mesh", type=int, help="devices on the concept axis")
+    c.add_argument("--output", "-o", help="write taxonomy here")
+    c.add_argument("--snapshot", help="write S/R snapshot (.npz)")
+    c.add_argument("--verify", action="store_true", help="diff vs CPU oracle")
+    c.add_argument("--instrument", action="store_true", help="phase timers")
+    c.set_defaults(fn=cmd_classify)
+
+    n = sub.add_parser("normalize", help="dump NF1-NF7 normal forms")
+    n.add_argument("ontology")
+    n.add_argument("--output", "-o")
+    n.set_defaults(fn=cmd_normalize)
+
+    s = sub.add_parser("stats", help="axiom-shape census")
+    s.add_argument("ontology")
+    s.set_defaults(fn=cmd_stats)
+
+    k = sub.add_parser("check", help="EL profile check")
+    k.add_argument("ontology")
+    k.set_defaults(fn=cmd_check)
+
+    m = sub.add_parser("multiply", help="synthetic n-copy scaling")
+    m.add_argument("ontology")
+    m.add_argument("n", type=int)
+    m.add_argument("--output", "-o", required=True)
+    m.add_argument("--crossed", action="store_true")
+    m.set_defaults(fn=cmd_multiply)
+
+    d = sub.add_parser("diff", help="verify against the CPU oracle")
+    d.add_argument("ontology")
+    d.set_defaults(fn=cmd_diff)
+
+    b = sub.add_parser("bench", help="timing loop on one ontology")
+    b.add_argument("ontology")
+    b.add_argument("--repeats", type=int, default=3)
+    b.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""Configuration — the rebuild of ``ShardInfo.properties`` +
+``misc/PropertyFileHandler.java``.
+
+The reference's config surface (cluster topology, rule→node weights,
+chunk size, work-stealing / instrumentation flags) maps onto the TPU
+design like this:
+
+=============================  ==========================================
+reference knob                 TPU-native equivalent
+=============================  ==========================================
+NODES_LIST (:20)               ``mesh_devices`` — #devices on the concept
+                               axis of the ``jax.sharding.Mesh``
+CR_TYPE* weights (:5-12)       gone: SPMD shards every rule uniformly; a
+                               per-rule ``backend`` override survives as
+                               the plugin boundary (``rule_backends``)
+chunk.size (:27-29)            ``pad_multiple`` — shard granularity of the
+                               concept axis
+work.stealing.enabled (:31)    gone: static SPMD balance by construction
+instrumentation.enabled (:32)  ``instrumentation`` — per-phase timers
+NORMALIZE_CACHE node (:24)     ``normalize_cache_path`` — gensym memo file
+=============================  ==========================================
+
+``from_properties`` still parses java-style ``key = value`` files so a
+reference deployment's config can be carried over.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ClassifierConfig:
+    #: number of mesh devices on the concept axis (None = single device)
+    mesh_devices: Optional[int] = None
+    #: concept-axis padding granularity (MXU tiling + shard divisibility)
+    pad_multiple: int = 128
+    #: matmul compute dtype for the AND-OR semiring ("bfloat16"|"float32")
+    matmul_dtype: str = "bfloat16"
+    max_iterations: int = 10_000
+    #: per-phase wall-clock tracing (reference instrumentation.enabled)
+    instrumentation: bool = False
+    #: persistable gensym cache for incremental re-runs (reference
+    #: NORMALIZE_CACHE, ShardInfo.properties:24)
+    normalize_cache_path: Optional[str] = None
+    #: per-rule backend override, the reference's rule→node plugin boundary:
+    #: {"CR1": "tpu", ...}; "cpu" routes that rule through the oracle in
+    #: hybrid verification runs
+    rule_backends: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_properties(cls, path: str) -> "ClassifierConfig":
+        """Parse a java-properties-style file (``key = value``, ``#``/``!``
+        comments), accepting both our keys and reference spellings."""
+        raw: Dict[str, str] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith(("#", "!")):
+                    continue
+                if "=" in line:
+                    k, v = line.split("=", 1)
+                    raw[k.strip()] = v.strip()
+        cfg = cls()
+        if "mesh.devices" in raw:
+            cfg.mesh_devices = int(raw["mesh.devices"])
+        elif "NODES_LIST" in raw:  # reference spelling: count the nodes
+            cfg.mesh_devices = len([n for n in raw["NODES_LIST"].split(",") if n])
+        if "pad.multiple" in raw:
+            cfg.pad_multiple = int(raw["pad.multiple"])
+        elif "chunk.size" in raw:  # nearest reference analog
+            cfg.pad_multiple = max(8, min(int(raw["chunk.size"]), 1024))
+        if "matmul.dtype" in raw:
+            cfg.matmul_dtype = raw["matmul.dtype"]
+        if "max.iterations" in raw:
+            cfg.max_iterations = int(raw["max.iterations"])
+        for key in ("instrumentation.enabled", "instrumentation"):
+            if key in raw:
+                cfg.instrumentation = raw[key].lower() == "true"
+        if "normalize.cache.path" in raw:
+            cfg.normalize_cache_path = raw["normalize.cache.path"]
+        for k, v in raw.items():
+            if k.startswith("backend."):  # backend.CR1 = tpu
+                cfg.rule_backends[k[len("backend."):]] = v
+        return cfg
+
+    def matmul_jnp_dtype(self):
+        import jax.numpy as jnp
+
+        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[self.matmul_dtype]

@@ -1,0 +1,1 @@
+"""Runtime: the end-to-end classifier, instrumentation, checkpointing."""

@@ -1,0 +1,86 @@
+"""Snapshot / resume of the saturation state.
+
+Parity with the reference's persistence story (SURVEY.md §5): Redis RDB
+persistence implicitly + timed BGSAVE snapshots for completeness-over-time
+analysis (``misc/ResultSnapshotter.java:22-53``).  Here a snapshot is an
+``.npz`` of the S/R boolean matrices (bit-packed with ``np.packbits``,
+8× smaller than bool bytes) plus the entity tables — enough to resume
+saturation, run incremental additions on top, or export the taxonomy
+offline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from distel_tpu.core.engine import SaturationResult
+from distel_tpu.core.indexing import IndexedOntology
+
+
+def save_snapshot(path: str, result: SaturationResult) -> None:
+    idx = result.idx
+    n = idx.n_concepts
+    s = result.s[:n, :n]
+    r = result.r[:n]
+    np.savez_compressed(
+        path,
+        s_packed=np.packbits(s, axis=1),
+        r_packed=np.packbits(r, axis=1),
+        s_cols=np.int64(s.shape[1]),
+        r_cols=np.int64(r.shape[1]),
+        iterations=np.int64(result.iterations),
+        derivations=np.int64(result.derivations),
+        concept_names=np.array(idx.concept_names, dtype=object),
+        role_names=np.array(idx.role_names, dtype=object),
+        links=idx.links,
+        meta=np.array(
+            [json.dumps({"time": time.time(), "converged": result.converged})],
+            dtype=object,
+        ),
+    )
+
+
+def load_snapshot(path: str) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Returns (S, R, info).  S/R are unpacked bool arrays over the logical
+    (unpadded) universe; info carries names/links/counters."""
+    z = np.load(path, allow_pickle=True)
+    s_cols = int(z["s_cols"])
+    r_cols = int(z["r_cols"])
+    s = np.unpackbits(z["s_packed"], axis=1)[:, :s_cols].astype(bool)
+    r = np.unpackbits(z["r_packed"], axis=1)[:, :r_cols].astype(bool)
+    info = {
+        "iterations": int(z["iterations"]),
+        "derivations": int(z["derivations"]),
+        "concept_names": list(z["concept_names"]),
+        "role_names": list(z["role_names"]),
+        "links": z["links"],
+        "meta": json.loads(str(z["meta"][0])),
+    }
+    return s, r, info
+
+
+class Snapshotter:
+    """Timed snapshot hook — the ResultSnapshotter cadence
+    (``misc/ResultSnapshotter.java:23-25``: every 2 min over a window)
+    adapted to the jit world: call ``maybe_snapshot`` between incremental
+    batches (inside one fused fixed point there is nothing to observe)."""
+
+    def __init__(self, path_prefix: str, interval_s: float = 120.0):
+        self.path_prefix = path_prefix
+        self.interval_s = interval_s
+        self._last = 0.0
+        self.count = 0
+
+    def maybe_snapshot(self, result: SaturationResult) -> Optional[str]:
+        now = time.time()
+        if now - self._last < self.interval_s:
+            return None
+        self._last = now
+        path = f"{self.path_prefix}.{self.count:04d}.npz"
+        save_snapshot(path, result)
+        self.count += 1
+        return path

@@ -1,0 +1,120 @@
+"""The end-to-end classifier — rebuild of ``ELClassifier.java`` + the
+run scripts' choreography (``scripts/run-all.sh``: load → classify →
+collect), collapsed into one process because the cluster is a device mesh,
+not a fleet of JVMs.
+
+Pipeline: parse → normalize → index → saturate (jit fixed point) →
+taxonomy, with per-phase instrumentation (SURVEY.md §5 tracing parity)
+and an optional differential check against the CPU oracle (the
+``test-classify.sh`` verification step of the reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.core.engine import SaturationEngine, SaturationResult
+from distel_tpu.core.indexing import Indexer, IndexedOntology
+from distel_tpu.frontend.normalizer import Normalizer, NormalizedOntology
+from distel_tpu.owl import parser as owl_parser
+from distel_tpu.runtime.instrumentation import PhaseTimer
+from distel_tpu.runtime.taxonomy import Taxonomy, extract_taxonomy
+
+
+@dataclass
+class ClassificationResult:
+    result: SaturationResult
+    taxonomy: Taxonomy
+    norm: NormalizedOntology
+    idx: IndexedOntology
+    timer: PhaseTimer
+
+    def summary(self) -> dict:
+        return {
+            "concepts": self.idx.n_concepts,
+            "roles": self.idx.n_roles,
+            "links": self.idx.n_links,
+            "normalized_axioms": self.norm.axiom_count(),
+            "removed_axioms": sum(self.norm.removed.values()),
+            "iterations": self.result.iterations,
+            "derivations": self.result.derivations,
+            "unsatisfiable": len(self.taxonomy.unsatisfiable),
+            "phases_ms": {k: round(v * 1000, 1) for k, v in self.timer.phases.items()},
+        }
+
+
+class ELClassifier:
+    """One classifier instance per config — owns the mesh and jit caches."""
+
+    def __init__(self, config: Optional[ClassifierConfig] = None):
+        self.config = config or ClassifierConfig()
+        self._mesh = None
+        if self.config.mesh_devices:
+            import jax
+
+            n = self.config.mesh_devices
+            devs = jax.devices()
+            if len(devs) < n:
+                raise ValueError(
+                    f"mesh_devices={n} but only {len(devs)} devices present"
+                )
+            self._mesh = jax.sharding.Mesh(np.array(devs[:n]), ("c",))
+
+    # ------------------------------------------------------------------
+
+    def classify_text(self, text: str, *, verify: bool = False) -> ClassificationResult:
+        timer = PhaseTimer(enabled=self.config.instrumentation)
+        with timer.phase("parse"):
+            onto = owl_parser.parse(text)
+        cache = None
+        cfg = self.config
+        if cfg.normalize_cache_path:
+            try:
+                cache = Normalizer.load_cache(cfg.normalize_cache_path)
+            except FileNotFoundError:
+                cache = None
+        with timer.phase("normalize"):
+            normalizer = Normalizer(cache=cache)
+            norm = normalizer.normalize(onto)
+        if cfg.normalize_cache_path:
+            normalizer.save_cache(cfg.normalize_cache_path)
+        with timer.phase("index"):
+            idx = Indexer().index(norm)
+        with timer.phase("compile+saturate"):
+            engine = SaturationEngine(
+                idx,
+                pad_multiple=cfg.pad_multiple,
+                mesh=self._mesh,
+                matmul_dtype=cfg.matmul_jnp_dtype(),
+            )
+            result = engine.saturate(cfg.max_iterations)
+        with timer.phase("taxonomy"):
+            taxonomy = extract_taxonomy(result)
+        if verify:
+            with timer.phase("verify"):
+                from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+                report = diff_engine_vs_oracle(norm, result)
+                if not report.ok():
+                    raise AssertionError(
+                        f"differential check failed:\n{report.summary()}"
+                    )
+        if cfg.instrumentation:
+            print(timer.report(), flush=True)
+        return ClassificationResult(result, taxonomy, norm, idx, timer)
+
+    def classify_file(self, path: str, **kw) -> ClassificationResult:
+        with open(path, "r", encoding="utf-8") as f:
+            return self.classify_text(f.read(), **kw)
+
+
+def classify(path_or_text: str, config: Optional[ClassifierConfig] = None, **kw):
+    """Convenience one-shot entry (the ``scripts/classifier.sh`` analog)."""
+    clf = ELClassifier(config)
+    if "\n" in path_or_text or path_or_text.lstrip().startswith(("Prefix", "Ontology", "SubClassOf")):
+        return clf.classify_text(path_or_text, **kw)
+    return clf.classify_file(path_or_text, **kw)

@@ -1,0 +1,64 @@
+"""Per-phase wall-clock tracing.
+
+Parity with the reference's instrumentation flag
+(``ShardInfo.properties:32``, ``misc/PropertyFileHandler.java:223-230``):
+every processor there stamps nanoTime phases (init, key-read, applyRule,
+chunk, steal-wait, blocking-wait, iteration — e.g.
+``base/Type1_1AxiomProcessorBase.java:183-214``).  Here the phases are the
+pipeline stages of one classify() call, plus the in-jit iteration count
+(XLA gives no per-rule wall splits inside the fused loop; per-rule
+attribution comes from ``jax.profiler`` traces, see ``trace_to``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class PhaseTimer:
+    enabled: bool = False
+    phases: Dict[str, float] = field(default_factory=dict)
+    order: List[str] = field(default_factory=list)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+            if name not in self.order:
+                self.order.append(name)
+            if self.enabled:
+                print(f"[distel] phase {name}: {dt * 1000:.1f} ms", flush=True)
+
+    def report(self) -> str:
+        total = sum(self.phases.values())
+        lines = [f"{'phase':<16}{'ms':>10}{'%':>7}"]
+        for name in self.order:
+            ms = self.phases[name] * 1000
+            pct = 100 * self.phases[name] / total if total else 0.0
+            lines.append(f"{name:<16}{ms:>10.1f}{pct:>6.1f}%")
+        lines.append(f"{'total':<16}{total * 1000:>10.1f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: Optional[str]):
+    """Optional XLA profiler capture around the saturation loop — the
+    deep-dive equivalent of the reference's per-phase prints."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

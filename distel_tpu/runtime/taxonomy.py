@@ -1,0 +1,107 @@
+"""Taxonomy extraction from a saturated S matrix.
+
+The rebuild of the reference's result post-processing
+(``test/ResultRearranger.java:57-105`` inverts the result-node zsets into
+direct S(X) sets; ``test/ResultDiffWriter.java:34-99`` dumps per-class
+diffs).  Here S is already direct; this module projects it onto the
+original class signature and computes the ELK-style taxonomy: equivalence
+classes, unsatisfiable classes, and direct (transitively-reduced)
+superclasses — vectorized numpy, no per-class loops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Set
+
+import numpy as np
+
+from distel_tpu.core.engine import SaturationResult
+from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID
+
+
+@dataclass
+class Taxonomy:
+    #: class name → sorted names of all (named, original) strict subsumers
+    subsumers: Dict[str, List[str]]
+    #: class name → equivalent class names (incl. itself)
+    equivalents: Dict[str, List[str]]
+    #: class name → direct parents (transitive reduction over canonical reps)
+    parents: Dict[str, List[str]]
+    unsatisfiable: List[str] = field(default_factory=list)
+
+    def superclasses(self, name: str, direct: bool = False) -> List[str]:
+        return self.parents[name] if direct else self.subsumers[name]
+
+    def write(self, path: str) -> None:
+        """Dump as functional-syntax axioms (the comparable artifact the
+        reference writes via ResultDiffWriter / writeResultsToFile,
+        ``test/ELClassifierTest.java:448-469``)."""
+        with open(path, "w") as f:
+            for name in sorted(self.unsatisfiable):
+                f.write(f"EquivalentClasses(<{name}> owl:Nothing)\n")
+            done = set()
+            for name, eqs in sorted(self.equivalents.items()):
+                key = tuple(sorted(eqs))
+                if len(eqs) > 1 and key not in done:
+                    done.add(key)
+                    f.write(
+                        "EquivalentClasses(" + " ".join(f"<{n}>" for n in key) + ")\n"
+                    )
+            for name, ps in sorted(self.parents.items()):
+                for p in ps:
+                    f.write(f"SubClassOf(<{name}> <{p}>)\n")
+
+
+def extract_taxonomy(result: SaturationResult) -> Taxonomy:
+    idx = result.idx
+    orig = idx.original_classes
+    # exclude ⊤/⊥ from the projected signature; they are handled specially
+    orig = orig[(orig != BOTTOM_ID) & (orig != TOP_ID)]
+    names = [idx.concept_names[i] for i in orig]
+    n = len(orig)
+    if n == 0:
+        return Taxonomy({}, {}, {}, [])
+
+    # S projected onto original classes: sub[i, j] = orig_i ⊑ orig_j
+    sub = result.s[np.ix_(orig, orig)]
+    unsat_mask = result.s[orig, BOTTOM_ID]
+    # unsatisfiable classes are ⊑ everything
+    sub = sub | unsat_mask[:, None]
+    np.fill_diagonal(sub, True)
+
+    eq = sub & sub.T  # mutual subsumption
+    strict = sub & ~eq
+
+    # canonical representative of each equivalence class: smallest index
+    canon = np.argmax(eq, axis=1)  # first True per row
+    is_canon = canon == np.arange(n)
+
+    # transitive reduction over canonical reps: parent p of c is direct iff
+    # no other strict subsumer q of c has p as strict subsumer of q
+    reps = np.nonzero(is_canon & ~unsat_mask)[0]
+    strict_r = strict[np.ix_(reps, reps)]
+    # indirect[c, p] = exists q: strict[c, q] & strict[q, p]
+    indirect = (strict_r.astype(np.uint8) @ strict_r.astype(np.uint8)) > 0
+    direct_r = strict_r & ~indirect
+
+    rep_names = [names[i] for i in reps]
+    rep_pos = {int(r): k for k, r in enumerate(reps)}
+
+    subsumers = {}
+    equivalents = {}
+    parents = {}
+    unsatisfiable = [names[i] for i in np.nonzero(unsat_mask)[0]]
+    unsat_set = set(unsatisfiable)
+    for i in range(n):
+        name = names[i]
+        equivalents[name] = sorted(names[j] for j in np.nonzero(eq[i])[0])
+        subsumers[name] = sorted(
+            names[j] for j in np.nonzero(strict[i])[0] if names[j] not in unsat_set
+        ) if name not in unsat_set else sorted(set(names) - {name})
+        if name in unsat_set:
+            parents[name] = []
+            continue
+        k = rep_pos[int(canon[i])]
+        parents[name] = sorted(rep_names[m] for m in np.nonzero(direct_r[k])[0])
+    return Taxonomy(subsumers, equivalents, parents, sorted(unsatisfiable))
