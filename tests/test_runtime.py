@@ -1,0 +1,221 @@
+"""End-to-end runtime tests: classifier pipeline, taxonomy, checkpoint,
+incremental classification, config, CLI."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.runtime.checkpoint import load_snapshot, save_snapshot, Snapshotter
+from distel_tpu.runtime.classifier import ELClassifier
+from distel_tpu.runtime.stats import axiom_counts, ontology_stats, result_stats
+from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+ONTO = """
+SubClassOf(Cat Mammal)
+SubClassOf(Mammal Animal)
+SubClassOf(Dog Mammal)
+EquivalentClasses(Feline Cat)
+SubClassOf(Cat ObjectSomeValuesFrom(hasParent Cat))
+SubClassOf(ObjectSomeValuesFrom(hasParent Animal) Animal)
+DisjointClasses(Cat Dog)
+SubClassOf(CatDog Cat)
+SubClassOf(CatDog Dog)
+"""
+
+
+@pytest.fixture(scope="module")
+def classified():
+    return ELClassifier().classify_text(ONTO)
+
+
+def test_classify_summary(classified):
+    s = classified.summary()
+    assert s["unsatisfiable"] == 1
+    assert s["iterations"] >= 2
+    assert "parse" in s["phases_ms"] and "compile+saturate" in s["phases_ms"]
+
+
+def test_taxonomy_structure(classified):
+    tax = classified.taxonomy
+    assert tax.unsatisfiable == ["CatDog"]
+    assert "Animal" in tax.subsumers["Cat"]
+    assert tax.parents["Cat"] == ["Mammal"]       # direct parent only
+    assert "Animal" not in tax.parents["Cat"]
+    assert sorted(tax.equivalents["Cat"]) == ["Cat", "Feline"]
+    # unsat class is subsumed by everything
+    assert "Dog" in tax.subsumers["CatDog"]
+
+
+def test_taxonomy_write_roundtrip(classified, tmp_path):
+    p = tmp_path / "taxonomy.ofn"
+    classified.taxonomy.write(str(p))
+    text = p.read_text()
+    assert "SubClassOf(<Cat> <Mammal>)" in text
+    assert "EquivalentClasses(<CatDog> owl:Nothing)" in text
+    assert "EquivalentClasses(<Cat> <Feline>)" in text
+
+
+def test_verify_flag_runs_oracle():
+    res = ELClassifier().classify_text(ONTO, verify=True)
+    assert res.result.converged
+
+
+def test_stats(classified):
+    st = ontology_stats(ONTO)
+    assert st["axioms"] == 9
+    assert st["classes"] >= 6
+    ac = axiom_counts(classified.result)
+    assert ac["derived_subsumptions"] > 0
+    rs = result_stats(classified.result)
+    assert rs["max_subsumer_set"] >= 4
+
+
+def test_checkpoint_roundtrip(classified, tmp_path):
+    p = str(tmp_path / "snap.npz")
+    save_snapshot(p, classified.result)
+    s, r, info = load_snapshot(p)
+    n = classified.idx.n_concepts
+    assert np.array_equal(s, classified.result.s[:n, :n])
+    assert info["concept_names"][:2] == ["owl:Nothing", "owl:Thing"]
+    assert info["meta"]["converged"] is True
+
+
+def test_snapshotter_cadence(classified, tmp_path):
+    sn = Snapshotter(str(tmp_path / "curve"), interval_s=0.0)
+    p1 = sn.maybe_snapshot(classified.result)
+    assert p1 and os.path.exists(p1)
+    sn.interval_s = 3600
+    assert sn.maybe_snapshot(classified.result) is None
+
+
+def test_incremental_matches_batch():
+    """Streaming increments must reach the same closure as one-shot
+    classification (the traffic-data streaming scenario)."""
+    inc = IncrementalClassifier()
+    inc.add_text("SubClassOf(A B)\nSubClassOf(A ObjectSomeValuesFrom(r C))")
+    r1 = inc.last_result
+    d1 = r1.derivations
+    inc.add_text("SubClassOf(B D)\nSubClassOf(ObjectSomeValuesFrom(r C) E)")
+    r2 = inc.last_result
+
+    # batch equivalent
+    clf = ELClassifier().classify_text(
+        "SubClassOf(A B)\nSubClassOf(A ObjectSomeValuesFrom(r C))\n"
+        "SubClassOf(B D)\nSubClassOf(ObjectSomeValuesFrom(r C) E)"
+    )
+    ids = inc.indexer.concept_ids
+    bids = clf.idx.concept_ids
+    for name in ("A", "B", "C", "D", "E"):
+        inc_sups = {
+            inc.indexer.concept_names[j]
+            for j in np.nonzero(r2.s[ids[name], : r2.idx.n_concepts])[0]
+        }
+        bat_sups = {
+            clf.idx.concept_names[j]
+            for j in np.nonzero(clf.result.s[bids[name], : clf.idx.n_concepts])[0]
+        }
+        assert inc_sups == bat_sups, name
+    # increment 2 only derived the *new* consequences
+    assert r2.derivations < d1 + 10
+    assert inc.increment == 2 and len(inc.history) == 2
+
+
+def test_incremental_new_entities_after_resume():
+    inc = IncrementalClassifier()
+    inc.add_text("SubClassOf(A B)")
+    inc.add_text("SubClassOf(NewClass A)\nSubClassOf(Other NewClass)")
+    r = inc.last_result
+    ids = inc.indexer.concept_ids
+    assert r.s[ids["Other"], ids["B"]]
+
+
+def test_config_from_properties(tmp_path):
+    p = tmp_path / "shard.properties"
+    p.write_text(
+        "# comment\n"
+        "mesh.devices = 4\n"
+        "pad.multiple = 256\n"
+        "matmul.dtype = float32\n"
+        "instrumentation.enabled = true\n"
+        "backend.CR1 = tpu\n"
+        "backend.CR6 = cpu\n"
+    )
+    cfg = ClassifierConfig.from_properties(str(p))
+    assert cfg.mesh_devices == 4
+    assert cfg.pad_multiple == 256
+    assert cfg.instrumentation is True
+    assert cfg.rule_backends == {"CR1": "tpu", "CR6": "cpu"}
+
+
+def test_config_reference_spellings(tmp_path):
+    p = tmp_path / "ShardInfo.properties"
+    p.write_text("NODES_LIST = nimbus2:6379,nimbus3:6379,nimbus4:6379\nchunk.size = 500\n")
+    cfg = ClassifierConfig.from_properties(str(p))
+    assert cfg.mesh_devices == 3
+    assert cfg.pad_multiple == 500
+
+
+CLI_ENV = None
+
+
+def _run_cli(*argv, cwd=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip TPU-tunnel registration
+    return subprocess.run(
+        [sys.executable, "-m", "distel_tpu.cli", *argv],
+        capture_output=True,
+        text=True,
+        cwd=cwd or os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+        timeout=300,
+    )
+
+
+@pytest.fixture(scope="module")
+def onto_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("cli") / "zoo.ofn"
+    p.write_text(ONTO)
+    return str(p)
+
+
+def test_cli_classify(onto_file, tmp_path):
+    out = str(tmp_path / "tax.ofn")
+    r = _run_cli("classify", onto_file, "-o", out, "--verify")
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout[: r.stdout.index("taxonomy written")])
+    assert summary["unsatisfiable"] == 1
+    assert os.path.exists(out)
+
+
+def test_cli_normalize(onto_file):
+    r = _run_cli("normalize", onto_file)
+    assert r.returncode == 0, r.stderr
+    assert "NF1" in r.stdout and "NF3" in r.stdout
+
+
+def test_cli_stats_and_check(onto_file):
+    r = _run_cli("stats", onto_file)
+    assert r.returncode == 0 and json.loads(r.stdout)["axioms"] == 9
+    r = _run_cli("check", onto_file)
+    assert r.returncode == 0
+
+
+def test_cli_diff(onto_file):
+    r = _run_cli("diff", onto_file)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_cli_multiply(onto_file, tmp_path):
+    out = str(tmp_path / "x3.ofn")
+    r = _run_cli("multiply", onto_file, "3", "-o", out)
+    assert r.returncode == 0, r.stderr
+    r2 = _run_cli("stats", out)
+    assert json.loads(r2.stdout)["axioms"] == 27
