@@ -48,6 +48,9 @@ class ClassifierConfig:
     #: {"CR1": "tpu", ...}; "cpu" routes that rule through the oracle in
     #: hybrid verification runs
     rule_backends: Dict[str, str] = field(default_factory=dict)
+    #: use the C++ load plane (native/distel_loader.cpp) when available —
+    #: ~13x faster text→tensors than the Python frontend
+    use_native_loader: bool = True
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -80,6 +83,8 @@ class ClassifierConfig:
                 cfg.instrumentation = raw[key].lower() == "true"
         if "normalize.cache.path" in raw:
             cfg.normalize_cache_path = raw["normalize.cache.path"]
+        if "native.loader" in raw:
+            cfg.use_native_loader = raw["native.loader"].lower() == "true"
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v

@@ -80,6 +80,9 @@ class IndexedOntology:
     #: the taxonomy/export layer projects onto
     original_classes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
     has_bottom_axioms: bool = False
+    #: out-of-profile axiom kinds dropped during loading (populated by the
+    #: native load plane; the Python path reports via NormalizedOntology.removed)
+    removed: Dict[str, int] = field(default_factory=dict)
 
     @property
     def n_links(self) -> int:

@@ -1,0 +1,193 @@
+"""ctypes binding to the native C++ load plane (native/distel_loader.cpp).
+
+The fast path for ``ELClassifier.classify_file``: OFN text → indexed int32
+tensors with zero Python AST materialization — the native equivalent of
+the reference's bulk loader (``init/AxiomLoader.java`` with its 28 GB JVM
+heap, ``scripts/load-axioms.sh:3``).  Falls back silently to the pure
+Python frontend when the shared library isn't built; closure equivalence
+between the two paths is enforced by tests/test_native_loader.py.
+
+Built on demand with ``make -C native`` (g++; no pybind11 — plain C ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distel_tpu.core.indexing import IndexedOntology
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libdistel_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_error: Optional[str] = None
+
+
+class _LoadResult(ctypes.Structure):
+    _fields_ = [
+        ("concept_names", ctypes.c_char_p),
+        ("concept_names_len", ctypes.c_int64),
+        ("n_concepts", ctypes.c_int64),
+        ("role_names", ctypes.c_char_p),
+        ("role_names_len", ctypes.c_int64),
+        ("n_roles", ctypes.c_int64),
+        ("nf1", ctypes.POINTER(ctypes.c_int32)), ("k1", ctypes.c_int64),
+        ("nf2", ctypes.POINTER(ctypes.c_int32)), ("k2", ctypes.c_int64),
+        ("nf3", ctypes.POINTER(ctypes.c_int32)), ("k3", ctypes.c_int64),
+        ("nf4", ctypes.POINTER(ctypes.c_int32)), ("k4", ctypes.c_int64),
+        ("links", ctypes.POINTER(ctypes.c_int32)), ("n_links", ctypes.c_int64),
+        ("chain_pairs", ctypes.POINTER(ctypes.c_int32)),
+        ("n_chain_pairs", ctypes.c_int64),
+        ("role_closure", ctypes.POINTER(ctypes.c_uint8)),
+        ("n_roles_closure", ctypes.c_int64),
+        ("removed", ctypes.c_char_p), ("removed_len", ctypes.c_int64),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+def _build() -> bool:
+    import sys
+
+    print(
+        f"[distel] building native loader (make -C {_NATIVE_DIR}) ...",
+        file=sys.stderr,
+        flush=True,
+    )
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return os.path.exists(_SO_PATH)
+    except Exception as e:
+        print(f"[distel] native loader build failed: {e}", file=sys.stderr)
+        return False
+
+
+def _get_lib():
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build():
+            _load_error = "native library build failed"
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            _load_error = str(e)
+            return None
+        lib.distel_load.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.distel_load.restype = ctypes.POINTER(_LoadResult)
+        lib.distel_free.argtypes = [ctypes.POINTER(_LoadResult)]
+        lib.distel_free.restype = None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def _arr(ptr, rows: int, cols: int) -> np.ndarray:
+    if rows == 0:
+        return np.zeros((0, cols), np.int32)
+    flat = np.ctypeslib.as_array(ptr, shape=(rows * cols,))
+    return flat.astype(np.int32).reshape(rows, cols)  # copy out of C memory
+
+
+def load_indexed(text: str) -> IndexedOntology:
+    """Parse + normalize + index in native code; returns the same
+    IndexedOntology the Python pipeline produces (ids may differ; closure
+    semantics are identical)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError(f"native loader unavailable: {_load_error}")
+    data = text.encode("utf-8")
+    res = lib.distel_load(data, len(data))
+    try:
+        r = res.contents
+        if r.error:
+            raise ValueError(f"native parse error: {r.error.decode()}")
+        concept_names = (
+            r.concept_names[: r.concept_names_len].decode().split("\n")[:-1]
+            if r.concept_names_len
+            else []
+        )
+        role_names = (
+            r.role_names[: r.role_names_len].decode().split("\n")[:-1]
+            if r.role_names_len
+            else []
+        )
+        nr = int(r.n_roles_closure)
+        closure_flat = np.ctypeslib.as_array(r.role_closure, shape=(nr * nr,))
+        nf1 = _arr(r.nf1, int(r.k1), 2)
+        nf2 = _arr(r.nf2, int(r.k2), 3)
+        nf4 = _arr(r.nf4, int(r.k4), 3)
+        original = [
+            i
+            for i, name in enumerate(concept_names)
+            if not name.startswith(("distel:gensym#", "distel:aux#", "ind:"))
+        ]
+        removed = {}
+        if r.removed_len:
+            for line in r.removed[: r.removed_len].decode().splitlines():
+                k, v = line.rsplit("=", 1)
+                removed[k] = int(v)
+        has_bottom = (
+            bool((nf1[:, 1] == 0).any())
+            or bool((nf2[:, 2] == 0).any())
+            or bool((nf4[:, 2] == 0).any())
+        )
+        return IndexedOntology(
+            n_concepts=int(r.n_concepts),
+            n_roles=max(int(r.n_roles), 1),
+            concept_names=concept_names,
+            concept_ids={n: i for i, n in enumerate(concept_names)},
+            role_names=role_names,
+            role_ids={n: i for i, n in enumerate(role_names)},
+            nf1=nf1,
+            nf2=nf2,
+            nf3=_arr(r.nf3, int(r.k3), 2),
+            nf4=nf4,
+            links=_arr(r.links, int(r.n_links), 2),
+            chain_pairs=_arr(r.chain_pairs, int(r.n_chain_pairs), 3),
+            role_closure=closure_flat.astype(bool).reshape(nr, nr).copy(),
+            original_classes=np.asarray(original, np.int32),
+            has_bottom_axioms=has_bottom,
+            removed=removed,
+        )
+    finally:
+        lib.distel_free(res)
+
+
+def removed_report(text: str) -> dict:
+    """Out-of-profile axiom counts from the native pass (ProfileChecker
+    parity for the fast path)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError(f"native loader unavailable: {_load_error}")
+    data = text.encode("utf-8")
+    res = lib.distel_load(data, len(data))
+    try:
+        r = res.contents
+        if r.error:
+            raise ValueError(f"native parse error: {r.error.decode()}")
+        out = {}
+        if r.removed_len:
+            for line in r.removed[: r.removed_len].decode().splitlines():
+                k, v = line.rsplit("=", 1)
+                out[k] = int(v)
+        return out
+    finally:
+        lib.distel_free(res)

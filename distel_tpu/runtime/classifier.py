@@ -29,17 +29,29 @@ from distel_tpu.runtime.taxonomy import Taxonomy, extract_taxonomy
 class ClassificationResult:
     result: SaturationResult
     taxonomy: Taxonomy
-    norm: NormalizedOntology
+    #: None when the native load plane was used (it keeps no Python IR)
+    norm: Optional[NormalizedOntology]
     idx: IndexedOntology
     timer: PhaseTimer
 
     def summary(self) -> dict:
+        if self.norm is not None:
+            normalized = self.norm.axiom_count()
+            removed = sum(self.norm.removed.values())
+        else:
+            # native path: count indexed NF rows (nf2 includes binarization
+            # aux rows; role axioms are folded into role_closure/chain_pairs)
+            normalized = int(
+                len(self.idx.nf1) + len(self.idx.nf2) + len(self.idx.nf3)
+                + len(self.idx.nf4) + len(self.idx.chain_pairs)
+            )
+            removed = sum(self.idx.removed.values())
         return {
             "concepts": self.idx.n_concepts,
             "roles": self.idx.n_roles,
             "links": self.idx.n_links,
-            "normalized_axioms": self.norm.axiom_count(),
-            "removed_axioms": sum(self.norm.removed.values()),
+            "normalized_axioms": normalized,
+            "removed_axioms": removed,
             "iterations": self.result.iterations,
             "derivations": self.result.derivations,
             "unsatisfiable": len(self.taxonomy.unsatisfiable),
@@ -68,22 +80,34 @@ class ELClassifier:
 
     def classify_text(self, text: str, *, verify: bool = False) -> ClassificationResult:
         timer = PhaseTimer(enabled=self.config.instrumentation)
-        with timer.phase("parse"):
-            onto = owl_parser.parse(text)
-        cache = None
         cfg = self.config
-        if cfg.normalize_cache_path:
-            try:
-                cache = Normalizer.load_cache(cfg.normalize_cache_path)
-            except FileNotFoundError:
-                cache = None
-        with timer.phase("normalize"):
-            normalizer = Normalizer(cache=cache)
-            norm = normalizer.normalize(onto)
-        if cfg.normalize_cache_path:
-            normalizer.save_cache(cfg.normalize_cache_path)
-        with timer.phase("index"):
-            idx = Indexer().index(norm)
+        norm = None
+        idx = None
+        # fast path: C++ load plane (text → tensors, no Python AST);
+        # the Python frontend remains the reference implementation and the
+        # path the oracle verification (and gensym caching) runs through
+        if cfg.use_native_loader and not verify and not cfg.normalize_cache_path:
+            from distel_tpu.owl import native_loader
+
+            if native_loader.native_available():
+                with timer.phase("load(native)"):
+                    idx = native_loader.load_indexed(text)
+        if idx is None:
+            with timer.phase("parse"):
+                onto = owl_parser.parse(text)
+            cache = None
+            if cfg.normalize_cache_path:
+                try:
+                    cache = Normalizer.load_cache(cfg.normalize_cache_path)
+                except FileNotFoundError:
+                    cache = None
+            with timer.phase("normalize"):
+                normalizer = Normalizer(cache=cache)
+                norm = normalizer.normalize(onto)
+            if cfg.normalize_cache_path:
+                normalizer.save_cache(cfg.normalize_cache_path)
+            with timer.phase("index"):
+                idx = Indexer().index(norm)
         with timer.phase("compile+saturate"):
             engine = SaturationEngine(
                 idx,

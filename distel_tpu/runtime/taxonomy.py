@@ -82,7 +82,10 @@ def extract_taxonomy(result: SaturationResult) -> Taxonomy:
     reps = np.nonzero(is_canon & ~unsat_mask)[0]
     strict_r = strict[np.ix_(reps, reps)]
     # indirect[c, p] = exists q: strict[c, q] & strict[q, p]
-    indirect = (strict_r.astype(np.uint8) @ strict_r.astype(np.uint8)) > 0
+    # (float32 so numpy dispatches to BLAS sgemm — integer matmul is a
+    # naive O(n^3) loop, ~200x slower at a few thousand classes)
+    sf = strict_r.astype(np.float32)
+    indirect = (sf @ sf) > 0
     direct_r = strict_r & ~indirect
 
     rep_names = [names[i] for i in reps]

@@ -1,0 +1,146 @@
+"""Native C++ load plane vs the Python reference frontend.
+
+Both paths (text → IndexedOntology) must yield the *same closure* — ids
+may differ, so equivalence is checked on per-name subsumer sets after
+saturation, plus oracle agreement (three-way differential)."""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.engine import SaturationEngine
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.owl import parser
+from distel_tpu.owl import native_loader
+from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+pytestmark = pytest.mark.skipif(
+    not native_loader.native_available(), reason="native library not built"
+)
+
+
+def _subsumers_by_name(idx, result):
+    out = {}
+    n = idx.n_concepts
+    for name, cid in idx.concept_ids.items():
+        if name.startswith(("distel:gensym#", "distel:aux#")):
+            continue
+        sups = {
+            idx.concept_names[j]
+            for j in np.nonzero(result.s[cid, :n])[0]
+            if not idx.concept_names[j].startswith(("distel:gensym#", "distel:aux#"))
+        }
+        out[name] = sups
+    return out
+
+
+def assert_equivalent(text):
+    idx_native = native_loader.load_indexed(text)
+    res_native = SaturationEngine(idx_native).saturate()
+
+    norm = normalize(parser.parse(text))
+    idx_py = index_ontology(norm)
+    res_py = SaturationEngine(idx_py).saturate()
+
+    a = _subsumers_by_name(idx_native, res_native)
+    b = _subsumers_by_name(idx_py, res_py)
+    assert a == b, {
+        k: (a.get(k), b.get(k)) for k in set(a) | set(b) if a.get(k) != b.get(k)
+    }
+    # and against the oracle
+    report = diff_engine_vs_oracle(norm, res_py)
+    assert report.ok(), report.summary()
+
+
+CASES = [
+    "SubClassOf(A B)\nSubClassOf(B C)",
+    "SubClassOf(ObjectIntersectionOf(A B C) D)\nSubClassOf(X A)\nSubClassOf(X B)\nSubClassOf(X C)",
+    (
+        "TransitiveObjectProperty(p)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(p B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(p D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(p D) E)"
+    ),
+    (
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) t)\n"
+        "SubObjectPropertyOf(t u)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(s D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(u D) E)"
+    ),
+    (
+        "ObjectPropertyDomain(r D)\nObjectPropertyRange(r E)\n"
+        "SubObjectPropertyOf(q r)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(q B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r E) F)"
+    ),
+    "DisjointClasses(A B C)\nSubClassOf(X A)\nSubClassOf(X B)",
+    (
+        "SubClassOf(A ObjectSomeValuesFrom(r ObjectIntersectionOf(B "
+        "ObjectSomeValuesFrom(s C))))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r B) D)"
+    ),
+    (
+        "Prefix(:=<http://x#>)\nOntology(<http://x>\n"
+        "Declaration(Class(:A))\nDeclaration(NamedIndividual(:a))\n"
+        "Declaration(NamedIndividual(:b))\n"
+        'AnnotationAssertion(rdfs:label :A "label")\n'
+        "ClassAssertion(:A :a)\nObjectPropertyAssertion(:r :a :b)\n"
+        "SubClassOf(ObjectSomeValuesFrom(:r owl:Thing) :HasR)\n)"
+    ),
+    "SubClassOf(A ObjectUnionOf(B C))\nSubClassOf(A D)\nHasKey(A () (p))",
+    "SubObjectPropertyOf(ObjectPropertyChain(p q r) s)\n"
+    "SubClassOf(A ObjectSomeValuesFrom(p B))\n"
+    "SubClassOf(B ObjectSomeValuesFrom(q C))\n"
+    "SubClassOf(C ObjectSomeValuesFrom(r D))\n"
+    "SubClassOf(ObjectSomeValuesFrom(s D) E)",
+    "EquivalentClasses(A ObjectIntersectionOf(B ObjectSomeValuesFrom(r C)))\n"
+    "SubClassOf(X B)\nSubClassOf(X ObjectSomeValuesFrom(r C))",
+]
+
+
+@pytest.mark.parametrize("i", range(len(CASES)))
+def test_native_matches_python(i):
+    assert_equivalent(CASES[i])
+
+
+def test_native_matches_python_synthetic():
+    from distel_tpu.frontend.ontology_tools import synthetic_ontology
+
+    text = synthetic_ontology(
+        n_classes=150, n_anatomy=40, n_locations=40, n_definitions=20
+    )
+    assert_equivalent(text)
+
+
+def test_native_random_ontologies():
+    import random
+    from tests.test_engine_dense import _random_ontology
+
+    for seed in range(6):
+        rng = random.Random(seed * 31 + 7)
+        assert_equivalent(_random_ontology(rng))
+
+
+def test_native_removed_report():
+    rep = native_loader.removed_report(
+        "SubClassOf(A ObjectUnionOf(B C))\nHasKey(A () (p))\n"
+        "ReflexiveObjectProperty(r)"
+    )
+    assert rep.get("SubClassOf(non-EL)") == 1
+    assert rep.get("HasKey") == 1
+    assert rep.get("ReflexiveObjectProperty") == 1
+
+
+def test_native_parse_error():
+    with pytest.raises(ValueError, match="native parse error"):
+        native_loader.load_indexed("SubClassOf(A <unclosed")
+
+
+def test_native_removed_in_summary():
+    from distel_tpu.runtime.classifier import ELClassifier
+
+    res = ELClassifier().classify_text(
+        "SubClassOf(A B)\nSubClassOf(C ObjectUnionOf(D E))"
+    )
+    assert res.summary()["removed_axioms"] == 1

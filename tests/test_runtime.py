@@ -38,7 +38,9 @@ def test_classify_summary(classified):
     s = classified.summary()
     assert s["unsatisfiable"] == 1
     assert s["iterations"] >= 2
-    assert "parse" in s["phases_ms"] and "compile+saturate" in s["phases_ms"]
+    # native load path reports one fused load phase; Python path reports parse
+    assert "compile+saturate" in s["phases_ms"]
+    assert "parse" in s["phases_ms"] or "load(native)" in s["phases_ms"]
 
 
 def test_taxonomy_structure(classified):
