@@ -36,8 +36,10 @@ class ClassifierConfig:
     mesh_devices: Optional[int] = None
     #: concept-axis padding granularity (MXU tiling + shard divisibility)
     pad_multiple: int = 128
-    #: matmul compute dtype for the AND-OR semiring ("bfloat16"|"float32")
-    matmul_dtype: str = "bfloat16"
+    #: matmul compute dtype for the AND-OR semiring
+    #: ("auto"|"bfloat16"|"float32") — auto picks bf16 on TPU (MXU rate),
+    #: f32 elsewhere (CPU cannot execute a raw bf16 dot)
+    matmul_dtype: str = "auto"
     max_iterations: int = 10_000
     #: per-phase wall-clock tracing (reference instrumentation.enabled)
     instrumentation: bool = False
@@ -91,6 +93,10 @@ class ClassifierConfig:
         return cfg
 
     def matmul_jnp_dtype(self):
+        """None means "auto": the engine resolves it against the actual
+        backend at construction time."""
         import jax.numpy as jnp
 
-        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[self.matmul_dtype]
+        return {"auto": None, "bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+            self.matmul_dtype
+        ]

@@ -40,7 +40,7 @@ disappears; ``changed`` plays the role of the global delta test.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, NamedTuple, Optional, Set, Tuple
 
 import jax
@@ -58,14 +58,68 @@ class SaturationState(NamedTuple):
     changed: jax.Array    # bool scalar
 
 
+class _RunOutput(NamedTuple):
+    """Device-resident outputs of one fixed-point run.  S and R travel
+    host-ward bit-packed (uint32, 32 concepts/word) — a 32x smaller D2H
+    transfer than XLA's byte-per-bool layout, which dominates wall time on
+    remote-attached chips; derivation counts are reduced on device for the
+    same reason."""
+
+    packed_s: jax.Array   # [Nc, Nc/32] uint32
+    packed_r: jax.Array   # [Nc, L/32] uint32
+    iteration: jax.Array  # i32 scalar
+    changed: jax.Array    # bool scalar
+    bits: jax.Array       # [Nc] i32: per-row popcount of live rows of S+R
+                          # (host sums in int64 — a device-side grand total
+                          # would overflow i32 past ~46k concepts and x64 is
+                          # disabled by default)
+
+
+def _pack_bits(x: jax.Array) -> jax.Array:
+    """bool [N, M] (M % 32 == 0) → uint32 [N, M/32], little-endian bit order
+    (bit i of word w = column 32*w + i)."""
+    w = x.reshape(x.shape[0], -1, 32).astype(jnp.uint32)
+    weights = jnp.left_shift(
+        jnp.asarray(1, jnp.uint32), jnp.arange(32, dtype=jnp.uint32)
+    )
+    return jnp.sum(w * weights, axis=-1, dtype=jnp.uint32)
+
+
+def _unpack_bits_host(p: np.ndarray, m: int) -> np.ndarray:
+    """Inverse of :func:`_pack_bits` on the host."""
+    b = np.unpackbits(
+        np.ascontiguousarray(p).view(np.uint8), axis=1, bitorder="little"
+    )
+    # unpackbits yields fresh 0/1 uint8 — reinterpret, don't copy
+    return b[:, :m].view(np.bool_)
+
+
 @dataclass
 class SaturationResult:
-    s: np.ndarray
-    r: np.ndarray
+    """Result of a saturation run.  ``s``/``r`` unpack lazily from the
+    bit-packed device transfer — consumers that only need counts (bench,
+    summary stats) never pay the unpacking cost."""
+
+    packed_s: np.ndarray  # [Nc, Nc/32] uint32
+    packed_r: np.ndarray  # [Nc, L/32] uint32
     iterations: int
     derivations: int
     idx: IndexedOntology
     converged: bool = True
+    _s: Optional[np.ndarray] = field(default=None, repr=False)
+    _r: Optional[np.ndarray] = field(default=None, repr=False)
+
+    @property
+    def s(self) -> np.ndarray:
+        if self._s is None:
+            self._s = _unpack_bits_host(self.packed_s, self.packed_s.shape[0])
+        return self._s
+
+    @property
+    def r(self) -> np.ndarray:
+        if self._r is None:
+            self._r = _unpack_bits_host(self.packed_r, self.packed_r.shape[1] * 32)
+        return self._r
 
     def subsumers(self, concept_id: int) -> Set[int]:
         return set(np.nonzero(self.s[concept_id])[0].tolist())
@@ -97,17 +151,30 @@ class SaturationEngine:
         pad_multiple: int = 128,
         mesh: Optional[jax.sharding.Mesh] = None,
         concept_axis: str = "c",
-        matmul_dtype=jnp.bfloat16,
+        matmul_dtype=None,
+        unroll: int = 4,
     ):
         self.idx = idx
         self.mesh = mesh
         self.concept_axis = concept_axis
+        if matmul_dtype is None:
+            # bf16 feeds the MXU at twice the rate of f32; CPU's thunk
+            # runtime cannot execute a raw bf16 dot, so tests fall back
+            matmul_dtype = (
+                jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            )
         self.matmul_dtype = matmul_dtype
+        # steps per while_loop body: amortizes the per-iteration convergence
+        # vote (a host roundtrip on remote-attached chips); extra post-
+        # convergence steps are idempotent and cost only the step itself
+        self.unroll = max(int(unroll), 1)
+        # bit-packing needs both axes 32-aligned; the mesh needs the concept
+        # axis divisible by the shard count — make pad_multiple satisfy both
+        pad_multiple = _pad_up(max(pad_multiple, 32), 32)
         if mesh is not None:
-            shards = mesh.shape[concept_axis]
-            pad_multiple = max(pad_multiple, 8) * shards
+            pad_multiple *= mesh.shape[concept_axis]
         self.nc = _pad_up(max(idx.n_concepts, 2), pad_multiple)
-        self.nl = max(_pad_up(idx.n_links, 8), 8)
+        self.nl = max(_pad_up(idx.n_links, 32), 32)
 
         h = idx.role_closure
         link_roles = idx.links[:, 0] if idx.n_links else np.zeros(0, np.int64)
@@ -154,20 +221,28 @@ class SaturationEngine:
             }
 
         self._step_jit = jax.jit(self._step)
-        self._saturate_jit = jax.jit(self._saturate_loop, static_argnums=(1,))
+        self._initial_jit = None
+        self._run_fresh_jit = jax.jit(self._run_fresh, static_argnums=(0,))
+        self._run_from_jit = jax.jit(self._run_from, static_argnums=(1,))
 
     # ------------------------------------------------------------ state
 
-    def initial_state(self) -> Tuple[jax.Array, jax.Array]:
+    def _initial_arrays(self) -> Tuple[jax.Array, jax.Array]:
         """S(X) = {X, ⊤} for every concept (reference
-        ``init/AxiomLoader.java:1237-1245``); R empty."""
+        ``init/AxiomLoader.java:1237-1245``); R empty.  Traceable — used
+        both inside the jitted fresh-run program and eagerly."""
         s = jnp.eye(self.nc, dtype=bool)
         s = s.at[:, TOP_ID].set(True)
         r = jnp.zeros((self.nc, self.nl), dtype=bool)
         if self._sharding is not None:
-            s = jax.device_put(s, self._sharding["s"])
-            r = jax.device_put(r, self._sharding["r"])
+            s = lax.with_sharding_constraint(s, self._sharding["s"])
+            r = lax.with_sharding_constraint(r, self._sharding["r"])
         return s, r
+
+    def initial_state(self) -> Tuple[jax.Array, jax.Array]:
+        if self._initial_jit is None:
+            self._initial_jit = jax.jit(self._initial_arrays)
+        return self._initial_jit()
 
     def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:
         """Embed a previous saturated state (old concept/link universe) into
@@ -242,25 +317,57 @@ class SaturationEngine:
 
     # -------------------------------------------------------- fixed point
 
-    def _saturate_loop(
-        self, state: Tuple[jax.Array, jax.Array], max_iters: int
-    ) -> SaturationState:
-        s0, r0 = state
+    def _live_bits(self, s: jax.Array, r: jax.Array) -> jax.Array:
+        """Per-row popcount of the non-padded rows of S and R ([Nc] i32).
+        Padded inert rows also accumulate ⊤-sourced bits and must not
+        inflate the derivation metric."""
+        n = self.idx.n_concepts
+        live = jnp.arange(self.nc) < n
+        per_row = jnp.sum(s, axis=1, dtype=jnp.int32) + jnp.sum(
+            r, axis=1, dtype=jnp.int32
+        )
+        return jnp.where(live, per_row, 0)
+
+    def _fixed_point(
+        self, s0: jax.Array, r0: jax.Array, max_iters: int
+    ) -> _RunOutput:
+        unroll = self.unroll
 
         def cond(st: SaturationState):
             return st.changed & (st.iteration < max_iters)
 
         def body(st: SaturationState):
-            s2, r2 = self._step(st.s, st.r)
+            s2, r2 = st.s, st.r
+            for _ in range(unroll):
+                s2, r2 = self._step(s2, r2)
             # global convergence vote — the reference's barrier AND-vote
             # (controller/CommunicationHandler.java:78-83) as one psum
             changed = jnp.any(s2 != st.s) | jnp.any(r2 != st.r)
-            return SaturationState(s2, r2, st.iteration + 1, changed)
+            return SaturationState(s2, r2, st.iteration + unroll, changed)
 
         init = SaturationState(
             s0, r0, jnp.asarray(0, jnp.int32), jnp.asarray(True)
         )
-        return lax.while_loop(cond, body, init)
+        final = lax.while_loop(cond, body, init)
+        return _RunOutput(
+            packed_s=_pack_bits(final.s),
+            packed_r=_pack_bits(final.r),
+            iteration=final.iteration,
+            changed=final.changed,
+            bits=self._live_bits(final.s, final.r),
+        )
+
+    def _run_fresh(self, max_iters: int) -> Tuple[_RunOutput, jax.Array]:
+        s0, r0 = self._initial_arrays()
+        init_bits = self._live_bits(s0, r0)
+        return self._fixed_point(s0, r0, max_iters), init_bits
+
+    def _run_from(
+        self, state: Tuple[jax.Array, jax.Array], max_iters: int
+    ) -> Tuple[_RunOutput, jax.Array]:
+        s0, r0 = state
+        init_bits = self._live_bits(s0, r0)
+        return self._fixed_point(s0, r0, max_iters), init_bits
 
     def saturate(
         self,
@@ -273,29 +380,34 @@ class SaturationEngine:
         smaller) saturated state — the incremental-reasoning path: EL+ is
         monotone, so re-saturating from an old closure plus new axioms
         equals classifying from scratch (the reference's CURRENT_INCREMENT
-        design, ``init/AxiomLoader.java:119-129``)."""
+        design, ``init/AxiomLoader.java:119-129``).
+
+        The whole run — init, unrolled while_loop, derivation popcount,
+        bit-packing — is one XLA program; the host only receives two packed
+        uint32 arrays and three scalars."""
+        # round the iteration budget up to a whole number of unrolled bodies
+        budget = _pad_up(max_iters, self.unroll)
         if initial is None:
-            initial = self.initial_state()
+            out, init_bits = self._run_fresh_jit(budget)
         else:
-            initial = self.embed_state(*initial)
-        # count only logical rows — padded inert rows also accumulate
-        # ⊤-sourced bits and must not inflate the derivation metric
-        n = self.idx.n_concepts
-        init_bits = int(jnp.sum(initial[0][:n])) + int(jnp.sum(initial[1][:n]))
-        final = self._saturate_jit(initial, max_iters)
-        jax.block_until_ready(final.s)
-        converged = not bool(final.changed)
+            out, init_bits = self._run_from_jit(
+                self.embed_state(*initial), budget
+            )
+        # exactly one host sync for the whole run
+        out, init_bits = jax.device_get((out, init_bits))
+        converged = not bool(out.changed)
         if not converged and not allow_incomplete:
             raise RuntimeError(
-                f"saturation did not converge within {max_iters} iterations"
+                f"saturation did not converge within {budget} iterations"
             )
-        s = np.asarray(final.s)
-        r = np.asarray(final.r)
-        derivations = int(s[:n].sum()) + int(r[:n].sum()) - init_bits
+        derivations = int(
+            np.asarray(out.bits, np.int64).sum()
+            - np.asarray(init_bits, np.int64).sum()
+        )
         return SaturationResult(
-            s=s,
-            r=r,
-            iterations=int(final.iteration),
+            packed_s=out.packed_s,
+            packed_r=out.packed_r,
+            iterations=int(out.iteration),
             derivations=derivations,
             idx=self.idx,
             converged=converged,

@@ -22,6 +22,10 @@ from distel_tpu.core.indexing import IndexedOntology
 
 
 def save_snapshot(path: str, result: SaturationResult) -> None:
+    # On-disk format is deliberately distinct from the engine's uint32 wire
+    # packing: snapshots slice away the padded rows/columns (word alignment
+    # would forbid that on the packed form) and use np.packbits so the file
+    # is self-describing with plain numpy at load time.
     idx = result.idx
     n = idx.n_concepts
     s = result.s[:n, :n]
