@@ -137,6 +137,12 @@ def _pad_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+def _host_bit_total(bits: np.ndarray) -> int:
+    """Sum per-row popcounts in int64 on the host (a device-side grand total
+    would overflow i32 past ~46k concepts; x64 is disabled by default)."""
+    return int(np.asarray(bits, np.int64).sum())
+
+
 class SaturationEngine:
     """Compiles an indexed ontology into a jitted fixed-point program.
 
@@ -221,6 +227,8 @@ class SaturationEngine:
             }
 
         self._step_jit = jax.jit(self._step)
+        self._observe_jit = None
+        self._pack_jit = jax.jit(_pack_bits)
         self._initial_jit = None
         self._run_fresh_jit = jax.jit(self._run_fresh, static_argnums=(0,))
         self._run_from_jit = jax.jit(self._run_from, static_argnums=(1,))
@@ -328,6 +336,18 @@ class SaturationEngine:
         )
         return jnp.where(live, per_row, 0)
 
+    def _advance(
+        self, s: jax.Array, r: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """One unrolled superstep + global convergence vote — the
+        reference's barrier AND-vote
+        (controller/CommunicationHandler.java:78-83) as one psum."""
+        s2, r2 = s, r
+        for _ in range(self.unroll):
+            s2, r2 = self._step(s2, r2)
+        changed = jnp.any(s2 != s) | jnp.any(r2 != r)
+        return s2, r2, changed
+
     def _fixed_point(
         self, s0: jax.Array, r0: jax.Array, max_iters: int
     ) -> _RunOutput:
@@ -337,12 +357,7 @@ class SaturationEngine:
             return st.changed & (st.iteration < max_iters)
 
         def body(st: SaturationState):
-            s2, r2 = st.s, st.r
-            for _ in range(unroll):
-                s2, r2 = self._step(s2, r2)
-            # global convergence vote — the reference's barrier AND-vote
-            # (controller/CommunicationHandler.java:78-83) as one psum
-            changed = jnp.any(s2 != st.s) | jnp.any(r2 != st.r)
+            s2, r2, changed = self._advance(st.s, st.r)
             return SaturationState(s2, r2, st.iteration + unroll, changed)
 
         init = SaturationState(
@@ -368,6 +383,66 @@ class SaturationEngine:
         s0, r0 = state
         init_bits = self._live_bits(s0, r0)
         return self._fixed_point(s0, r0, max_iters), init_bits
+
+    def _observe_round(
+        self, s: jax.Array, r: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """:meth:`_advance` plus the live-bit count — the observable unit
+        of :meth:`saturate_observed`."""
+        s2, r2, changed = self._advance(s, r)
+        return s2, r2, changed, self._live_bits(s2, r2)
+
+    def saturate_observed(
+        self,
+        max_iters: int = 10_000,
+        *,
+        observer=None,
+        initial: Optional[Tuple[jax.Array, jax.Array]] = None,
+        allow_incomplete: bool = False,
+    ) -> SaturationResult:
+        """Fixed point with per-superstep observation.
+
+        The observable analog of the reference's progress plane: the
+        pub-sub gossip consumed by ``worksteal/ProgressMessageHandler.java``
+        and the timed completeness snapshots of ``misc/ResultSnapshotter.java``.
+        One fused program per superstep instead of one per run — slower
+        than :meth:`saturate` (a host sync per superstep), so use it for
+        monitoring/analysis, not benchmarking.
+
+        ``observer`` is called after every superstep with
+        ``(iteration, derivations_so_far, changed)``.
+        """
+        if self._observe_jit is None:
+            # old s/r are dead after each call — donate so the per-superstep
+            # path needs no more state memory than the fused while_loop
+            self._observe_jit = jax.jit(
+                self._observe_round, donate_argnums=(0, 1)
+            )
+        if initial is None:
+            s, r = self.initial_state()
+        else:
+            s, r = self.embed_state(*initial)
+        init_total = _host_bit_total(jax.device_get(self._live_bits(s, r)))
+        budget = _pad_up(max_iters, self.unroll)
+        iteration, converged = 0, False
+        total = init_total
+        while iteration < budget:
+            s, r, changed_dev, bits = self._observe_jit(s, r)
+            iteration += self.unroll
+            changed, bits_host = jax.device_get((changed_dev, bits))
+            total = _host_bit_total(bits_host)
+            if observer is not None:
+                observer(iteration, total - init_total, bool(changed))
+            if not changed:
+                converged = True
+                break
+        packed_s, packed_r = jax.device_get(
+            (self._pack_jit(s), self._pack_jit(r))
+        )
+        return self._finish(
+            packed_s, packed_r, iteration, total - init_total,
+            converged, allow_incomplete, budget,
+        )
 
     def saturate(
         self,
@@ -395,19 +470,30 @@ class SaturationEngine:
             )
         # exactly one host sync for the whole run
         out, init_bits = jax.device_get((out, init_bits))
-        converged = not bool(out.changed)
+        derivations = _host_bit_total(out.bits) - _host_bit_total(init_bits)
+        return self._finish(
+            out.packed_s, out.packed_r, int(out.iteration), derivations,
+            not bool(out.changed), allow_incomplete, budget,
+        )
+
+    def _finish(
+        self,
+        packed_s: np.ndarray,
+        packed_r: np.ndarray,
+        iterations: int,
+        derivations: int,
+        converged: bool,
+        allow_incomplete: bool,
+        budget: int,
+    ) -> SaturationResult:
         if not converged and not allow_incomplete:
             raise RuntimeError(
                 f"saturation did not converge within {budget} iterations"
             )
-        derivations = int(
-            np.asarray(out.bits, np.int64).sum()
-            - np.asarray(init_bits, np.int64).sum()
-        )
         return SaturationResult(
-            packed_s=out.packed_s,
-            packed_r=out.packed_r,
-            iterations=int(out.iteration),
+            packed_s=packed_s,
+            packed_r=packed_r,
+            iterations=iterations,
             derivations=derivations,
             idx=self.idx,
             converged=converged,

@@ -221,3 +221,49 @@ def test_cli_multiply(onto_file, tmp_path):
     assert r.returncode == 0, r.stderr
     r2 = _run_cli("stats", out)
     assert json.loads(r2.stdout)["axioms"] == 27
+
+
+# ---------------------------------------------------------------- progress
+
+
+def test_saturate_observed_matches_saturate():
+    from distel_tpu.core.engine import SaturationEngine
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+    from distel_tpu.runtime.progress import ProgressReporter
+
+    idx = index_ontology(normalize(parser.parse(ONTO)))
+    engine = SaturationEngine(idx)
+    plain = engine.saturate()
+    reporter = ProgressReporter().start()
+    observed = engine.saturate_observed(observer=reporter)
+
+    assert observed.converged
+    assert observed.derivations == plain.derivations
+    assert np.array_equal(observed.packed_s, plain.packed_s)
+    assert np.array_equal(observed.packed_r, plain.packed_r)
+
+    # reporter collected a monotone completeness curve ending converged
+    curve = reporter.completeness_curve()
+    assert len(curve) >= 1
+    derivs = [d for _, d in curve]
+    assert derivs == sorted(derivs)
+    assert derivs[-1] == plain.derivations
+    assert reporter.completion_fraction() == 1.0
+    s = reporter.summary()
+    assert s["converged"] and s["derivations"] == plain.derivations
+
+
+def test_progress_reporter_echo(capsys):
+    import sys as _sys
+
+    from distel_tpu.runtime.progress import ProgressReporter
+
+    r = ProgressReporter(echo=True, stream=_sys.stdout).start()
+    r(2, 10, True)
+    r(4, 15, False)
+    out = capsys.readouterr().out
+    assert "iter=2" in out and "fraction=1.000" in out.splitlines()[-1]
+    assert "fraction=0.000" in out.splitlines()[0]
+    assert r.records[0].rate >= 0
