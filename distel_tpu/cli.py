@@ -48,9 +48,9 @@ def cmd_classify(args) -> int:
 
 def cmd_normalize(args) -> int:
     from distel_tpu.frontend.normalizer import normalize
-    from distel_tpu.owl import parser
+    from distel_tpu.owl import loader as parser_compat
 
-    norm = normalize(parser.parse_file(args.ontology))
+    norm = normalize(parser_compat.load_file(args.ontology))
     out = sys.stdout if not args.output else open(args.output, "w")
     try:
         for a, b in norm.nf1:
@@ -85,19 +85,19 @@ def cmd_stats(args) -> int:
 
 def cmd_check(args) -> int:
     from distel_tpu.frontend.profile_checker import check_profile
-    from distel_tpu.owl import parser
+    from distel_tpu.owl import loader as parser_compat
 
-    kept, removed = check_profile(parser.parse_file(args.ontology))
+    kept, removed = check_profile(parser_compat.load_file(args.ontology))
     print(json.dumps({"in_profile": kept, "removed": dict(removed)}, indent=2))
     return 0 if not removed else 1
 
 
 def cmd_multiply(args) -> int:
     from distel_tpu.frontend.ontology_tools import multiply_ontology
-    from distel_tpu.owl import parser
+    from distel_tpu.owl import loader as parser_compat
     from distel_tpu.owl.writer import write_file
 
-    onto = parser.parse_file(args.ontology)
+    onto = parser_compat.load_file(args.ontology)
     out = multiply_ontology(onto, args.n, crossed=args.crossed)
     write_file(out, args.output)
     print(f"{len(out)} axioms written to {args.output}")
@@ -106,10 +106,10 @@ def cmd_multiply(args) -> int:
 
 def cmd_diff(args) -> int:
     from distel_tpu.frontend.normalizer import normalize
-    from distel_tpu.owl import parser
+    from distel_tpu.owl import loader as parser_compat
     from distel_tpu.testing.differential import classify_and_diff
 
-    norm = normalize(parser.parse_file(args.ontology))
+    norm = normalize(parser_compat.load_file(args.ontology))
     _, report = classify_and_diff(norm)
     print(report.summary())
     return 0 if report.ok() else 1
@@ -117,11 +117,11 @@ def cmd_diff(args) -> int:
 
 def cmd_bench(args) -> int:
     from distel_tpu.frontend.normalizer import normalize
-    from distel_tpu.owl import parser
+    from distel_tpu.owl import loader as parser_compat
     from distel_tpu.core.indexing import index_ontology
     from distel_tpu.core.engine import SaturationEngine
 
-    norm = normalize(parser.parse_file(args.ontology))
+    norm = normalize(parser_compat.load_file(args.ontology))
     idx = index_ontology(norm)
     engine = SaturationEngine(idx)
     times = []

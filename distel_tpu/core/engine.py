@@ -421,7 +421,10 @@ class SaturationEngine:
         if initial is None:
             s, r = self.initial_state()
         else:
+            # embed_state may return the caller's buffers unchanged when
+            # shapes already match — copy so donation can't delete them
             s, r = self.embed_state(*initial)
+            s, r = jnp.array(s, copy=True), jnp.array(r, copy=True)
         init_total = _host_bit_total(jax.device_get(self._live_bits(s, r)))
         budget = _pad_up(max_iters, self.unroll)
         iteration, converged = 0, False

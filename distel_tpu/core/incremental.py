@@ -24,7 +24,7 @@ from distel_tpu.config import ClassifierConfig
 from distel_tpu.core.engine import SaturationEngine, SaturationResult
 from distel_tpu.core.indexing import Indexer
 from distel_tpu.frontend.normalizer import NormalizedOntology, Normalizer
-from distel_tpu.owl import parser as owl_parser
+from distel_tpu.owl import loader as owl_loader
 
 
 def _merge(into: NormalizedOntology, batch: NormalizedOntology) -> None:
@@ -54,7 +54,7 @@ class IncrementalClassifier:
         self.last_result: Optional[SaturationResult] = None
 
     def add_text(self, text: str) -> SaturationResult:
-        return self.add_ontology(owl_parser.parse(text))
+        return self.add_ontology(owl_loader.load(text))
 
     def add_ontology(self, onto) -> SaturationResult:
         normalizer = Normalizer(cache=self._normalizer_cache)

@@ -1,0 +1,19 @@
+"""TPU ops: bit-packed boolean linear algebra for the saturation engine.
+
+The reference keeps its boolean state as Redis sets/zsets; the dense engine
+(``core/engine.py``) keeps it as XLA bool arrays (one byte per bit).  This
+package provides the third representation — uint32 bitsets (32 concepts per
+word) — plus the Pallas TPU kernels that compute directly on it, which is
+what lets the single-chip concept ceiling grow ~8x (SURVEY.md §7 step 6).
+"""
+
+from distel_tpu.ops.bitpack import (  # noqa: F401
+    gather_bit_columns,
+    pack_bool_columns,
+    scatter_or_columns,
+    unpack_words,
+)
+from distel_tpu.ops.bitmatmul import (  # noqa: F401
+    contraction_bit_order,
+    packed_andor_matmul,
+)

@@ -1,0 +1,215 @@
+"""Packed AND-OR semiring matmul on the MXU (Pallas TPU kernel).
+
+Computes ``C = (A ⊙ B)`` where ``⊙`` is the boolean AND-OR product and A —
+the *state* operand, the saturation engine's R matrix that persists in HBM
+across the whole fixed point — is bit-packed 32-to-a-uint32:
+
+    A  [M, KW] uint32 — rows packed along the contraction axis
+    B  [K, N]  int8   — per-step operand, rows in *kernel contraction
+                        order* (see below)
+    C  [M, N]  int8   — 0/1 output
+
+Packing A is the scale lever: the engine's R matrix is read in full by
+every step, and packed words move 8x fewer HBM bytes than XLA's
+byte-per-bool arrays (32x fewer than bf16).  B and C are axiom-indexed
+per-step temporaries, so they stay byte-wide — every in-kernel op on them
+is lane-aligned, which keeps the Mosaic program small and fast to compile
+(sub-lane uint32 slicing blows up lowering time).
+
+The kernel unpacks each A tile on the VPU (32 shifted copies of the whole
+lane-aligned tile, concatenated bit-plane-major) and contracts on the MXU
+with f32 accumulation — exact for any count below 2^24 ones.  This is the
+hot op of the saturation engine: CR4's ``S[:,b] ∨= R ⊙ W`` and CR6's
+chain join (SURVEY.md §7), i.e. the reference's two-sided hash join
+(``RolePairHandler.java:421-425``) as one matmul.
+
+Kernel contraction order
+------------------------
+The concat unpack of an A tile ``[TM, TKW]`` emits bit p of word w at
+position ``p*TKW + w``.  B's rows must line up with that order, so a
+static permutation (:func:`contraction_bit_order`) maps kernel row
+position → logical bit index.  Callers bake the permutation into their
+*static* index arrays (fillers, masks) at trace time — nothing is permuted
+at runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distel_tpu.ops.bitpack import unpack_words
+
+
+def _pad_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def contraction_bit_order(n_words: int, tkw: int) -> np.ndarray:
+    """Logical bit index for each kernel contraction position.
+
+    ``n_words`` must be a multiple of the tile width ``tkw``.  Position
+    ``rho = k*(tkw*32) + p*tkw + w`` (k-th tile, bit-plane p, word w)
+    holds logical column ``(k*tkw + w)*32 + p``.
+    """
+    assert n_words % tkw == 0
+    k = np.arange(n_words // tkw)[:, None, None]
+    p = np.arange(32)[None, :, None]
+    w = np.arange(tkw)[None, None, :]
+    return ((k * tkw + w) * 32 + p).reshape(-1)
+
+
+def _unpack_tile(words: jax.Array, dtype) -> jax.Array:
+    """[R, W] uint32 → [R, W*32] dtype; position p*W + w = bit p of word w.
+    Mosaic has no uint32→float cast, so bits hop through int32."""
+    parts = [
+        (
+            (words >> jnp.asarray(p, jnp.uint32)) & jnp.asarray(1, jnp.uint32)
+        ).astype(jnp.int32)
+        for p in range(32)
+    ]
+    return jnp.concatenate(parts, axis=1).astype(dtype)
+
+
+def _andor_kernel(a_ref, b_ref, o_ref, acc_ref, *, dtype):
+    """Grid (i, j, k), k innermost; acc [TM, TN] f32 persists across k."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    a = _unpack_tile(a_ref[:], dtype)                   # [TM, TKW*32]
+    b = b_ref[:].astype(jnp.int32).astype(dtype)        # [TKW*32, TN]
+    acc_ref[:] += jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _():
+        o_ref[:] = (acc_ref[:] > 0).astype(jnp.int32).astype(jnp.int8)
+
+
+class PackedMatmulPlan:
+    """Shape-specialized packed AND-OR matmul.
+
+    ``plan = PackedMatmulPlan(m, kw, n)`` fixes the (padded) operand
+    shapes and tiling; ``plan.bit_order`` is the kernel contraction order
+    callers use to lay out B's rows; ``plan(a, b)`` runs the kernel.
+
+    ``interpret=True`` runs the Pallas interpreter — the CPU test path.
+    ``use_xla=True`` computes the same contract with plain XLA ops
+    (unpack → matmul → threshold), used as the reference implementation
+    and the fallback on hosts without Mosaic.
+    """
+
+    def __init__(
+        self,
+        m: int,
+        kw: int,
+        n: int,
+        *,
+        tm: int = 256,
+        tkw: int = 128,
+        tn: int = 256,
+        dtype=None,
+        interpret: bool = False,
+        use_xla: Optional[bool] = None,
+    ):
+        self.m, self.kw, self.n = m, kw, n
+        self.tm, self.tkw, self.tn = tm, tkw, tn
+        self.m_p = _pad_up(max(m, 1), tm)
+        self.kw_p = _pad_up(max(kw, 1), tkw)
+        self.n_p = _pad_up(max(n, 1), tn)
+        self.k_p = self.kw_p * 32
+        if dtype is None:
+            dtype = (
+                jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            )
+        self.dtype = dtype
+        self.interpret = interpret
+        if use_xla is None:
+            use_xla = jax.default_backend() != "tpu" and not interpret
+        self.use_xla = use_xla
+        #: kernel row position → logical bit index (length k_p)
+        self.bit_order = contraction_bit_order(self.kw_p, tkw)
+
+    # ---------------------------------------------------------------- call
+
+    def _pad(self, a: jax.Array, b: jax.Array):
+        a = jnp.pad(
+            a, ((0, self.m_p - a.shape[0]), (0, self.kw_p - a.shape[1]))
+        )
+        b = jnp.pad(
+            b, ((0, self.k_p - b.shape[0]), (0, self.n_p - b.shape[1]))
+        )
+        return a, b
+
+    def __call__(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        """a [m, kw] uint32; b [<=k_p, n] int8 rows in ``bit_order``.
+        Returns C [m, n] int8 (0/1)."""
+        if self.use_xla:
+            return self._xla(a, b)
+        a, b = self._pad(a, b)
+        gm = self.m_p // self.tm
+        gn = self.n_p // self.tn
+        gk = self.kw_p // self.tkw
+        out = pl.pallas_call(
+            functools.partial(_andor_kernel, dtype=self.dtype),
+            grid=(gm, gn, gk),
+            in_specs=[
+                pl.BlockSpec(
+                    (self.tm, self.tkw),
+                    lambda i, j, k: (i, k),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (self.tkw * 32, self.tn),
+                    lambda i, j, k: (k, j),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (self.tm, self.tn),
+                lambda i, j, k: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((self.m_p, self.n_p), jnp.int8),
+            scratch_shapes=[pltpu.VMEM((self.tm, self.tn), jnp.float32)],
+            interpret=self.interpret,
+        )(a, b)
+        return out[: self.m, : self.n]
+
+    def _xla(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        """Reference/fallback: same contract via unpack → matmul."""
+        a, b = self._pad(a, b)
+        a_bits = unpack_words(a, self.k_p)          # logical order
+        a_kern = a_bits[:, self.bit_order]          # kernel order
+        dt = self.dtype
+        prod = jnp.matmul(
+            a_kern.astype(dt), b.astype(dt),
+            preferred_element_type=jnp.float32,
+        )
+        return (prod > 0).astype(jnp.int8)[: self.m, : self.n]
+
+
+def packed_andor_matmul(
+    a: jax.Array, b_logical: jax.Array, **plan_kw
+) -> jax.Array:
+    """One-shot convenience: ``b_logical`` [K, N] int8/bool rows are in
+    logical bit order; this permutes them at runtime (a gather) — fine for
+    tests/small calls.  Hot paths should build B directly in
+    ``plan.bit_order`` instead."""
+    plan = PackedMatmulPlan(a.shape[0], a.shape[1], b_logical.shape[1], **plan_kw)
+    valid = plan.bit_order < b_logical.shape[0]
+    src = np.where(valid, plan.bit_order, 0)
+    b = jnp.where(
+        jnp.asarray(valid)[:, None],
+        b_logical.astype(jnp.int8)[src],
+        jnp.asarray(0, jnp.int8),
+    )
+    return plan(a, b)

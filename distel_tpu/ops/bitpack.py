@@ -1,0 +1,93 @@
+"""Bitset primitives: boolean matrices packed 32 columns to a uint32 word.
+
+Layout (the "standard" layout, shared with ``core/engine.py``'s wire
+packing): logical column ``c`` lives in word ``c >> 5``, bit ``c & 31``
+(little-endian within the word).  All ops here are pure jnp — they trace
+into the saturation step's XLA program; the MXU contraction over packed
+operands is in ``ops/bitmatmul.py``.
+
+These replace the reference's per-key Redis set reads/writes
+(``pipeline/PipelineManager.java``) at 32 set-memberships per word.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_bool_columns(x) -> jnp.ndarray:
+    """bool [N, M] (M % 32 == 0) → uint32 [N, M/32], standard layout."""
+    w = x.reshape(x.shape[0], -1, 32).astype(jnp.uint32)
+    weights = jnp.left_shift(
+        jnp.asarray(1, jnp.uint32), jnp.arange(32, dtype=jnp.uint32)
+    )
+    return jnp.sum(w * weights, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_words(p, m: int) -> jnp.ndarray:
+    """uint32 [N, W] → bool [N, m] (m <= 32*W), standard layout."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (p[:, :, None] >> shifts) & jnp.asarray(1, jnp.uint32)
+    return bits.reshape(p.shape[0], -1)[:, :m].astype(bool)
+
+
+def gather_bit_columns(p, cols: np.ndarray) -> jnp.ndarray:
+    """Extract logical columns ``cols`` from packed ``p`` [N, W] →
+    bool [N, len(cols)].  ``cols`` is a static numpy index vector, so the
+    word/bit split costs nothing at runtime."""
+    cols = np.asarray(cols)
+    if cols.size == 0:
+        return jnp.zeros((p.shape[0], 0), bool)
+    words = p[:, cols >> 5]
+    shifts = jnp.asarray((cols & 31).astype(np.uint32))
+    return ((words >> shifts) & jnp.asarray(1, jnp.uint32)).astype(bool)
+
+
+class ColumnScatter:
+    """Static plan for OR-scattering source bit vectors into packed columns.
+
+    Given target logical columns ``targets[j]`` (with repeats — many axioms
+    share a superclass), precomputes:
+      * the distinct target columns ``d`` and the map ``inv: j → d``;
+      * each distinct column's word and bit position.
+
+    At runtime :meth:`apply` OR-reduces the per-axiom source columns into
+    the distinct targets (scatter-max in bool space — ``max`` is OR on
+    0/1), then rebuilds words by scatter-*add*: distinct columns have
+    distinct (word, bit) pairs, so the added powers of two never carry —
+    addition IS bitwise OR here.  One pass replaces the reference's
+    per-axiom ``zadd`` storms against the result node
+    (``base/Type1_1AxiomProcessorBase.java:118-143``).
+    """
+
+    def __init__(self, targets: np.ndarray, n_words: int):
+        targets = np.asarray(targets, np.int64)
+        self.n_words = n_words
+        self.d_cols, self.inv = np.unique(targets, return_inverse=True)
+        self.d_words = (self.d_cols >> 5).astype(np.int32)
+        self.d_shifts = (self.d_cols & 31).astype(np.uint32)
+
+    @property
+    def n_distinct(self) -> int:
+        return len(self.d_cols)
+
+    def apply(self, packed, source_bits) -> jnp.ndarray:
+        """OR ``source_bits`` [N, K] (bool, axiom-ordered) into ``packed``
+        [N, W] at this plan's target columns; returns the new packed."""
+        if self.n_distinct == 0:
+            return packed
+        n = packed.shape[0]
+        u = jnp.zeros((n, self.n_distinct), bool)
+        u = u.at[:, self.inv].max(source_bits)
+        v = u.astype(jnp.uint32) << jnp.asarray(self.d_shifts)
+        upd = jnp.zeros((n, self.n_words), jnp.uint32)
+        upd = upd.at[:, self.d_words].add(v)
+        return packed | upd
+
+
+def scatter_or_columns(packed, source_bits, targets: np.ndarray) -> jnp.ndarray:
+    """One-shot convenience wrapper over :class:`ColumnScatter`."""
+    return ColumnScatter(np.asarray(targets), packed.shape[1]).apply(
+        packed, source_bits
+    )

@@ -1,0 +1,49 @@
+"""Format auto-detection — the OWLAPI ``OWLManager.loadOntology`` analog
+(reference ``init/AxiomLoader.java:127-136``): one entry point that sniffs
+functional syntax, RDF/XML, or OWL/XML and dispatches to the right reader.
+"""
+
+from __future__ import annotations
+
+import re
+
+from distel_tpu.owl import owlxml, parser, rdfxml
+from distel_tpu.owl import syntax as S
+
+_ROOT_ELEM_RE = re.compile(r"<([A-Za-z_][\w.-]*:)?([A-Za-z_][\w.-]*)")
+
+
+def detect_format(text: str) -> str:
+    """'ofn' | 'rdfxml' | 'owlxml' by content sniffing.  XML documents are
+    routed by their *root element* (an OWL/XML file routinely declares
+    xmlns:rdf too, so substring checks misfire)."""
+    head = text.lstrip("﻿ \t\r\n")[:4096]
+    if head.startswith("<"):
+        # first element that is not a declaration/comment/doctype
+        pos = 0
+        while True:
+            m = _ROOT_ELEM_RE.search(head, pos)
+            if m is None:
+                return "rdfxml"
+            start = head.rfind("<", 0, m.start() + 1)
+            if head.startswith(("<?", "<!"), start):
+                pos = m.end()
+                continue
+            local = m.group(2)
+            return "owlxml" if local == "Ontology" else "rdfxml"
+    return "ofn"
+
+
+def load(text: str) -> S.Ontology:
+    fmt = detect_format(text)
+    if fmt == "rdfxml":
+        return rdfxml.parse(text)
+    if fmt == "owlxml":
+        return owlxml.parse(text)
+    return parser.parse(text)
+
+
+def load_file(path: str) -> S.Ontology:
+    # utf-8-sig: tolerate BOMs from Windows exports
+    with open(path, "r", encoding="utf-8-sig") as f:
+        return load(f.read())

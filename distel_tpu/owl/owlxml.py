@@ -1,0 +1,147 @@
+"""OWL/XML reader for the EL fragment.
+
+OWL/XML (the ``.owx`` serialization OWLAPI writes by default for many
+tools) mirrors functional syntax one-to-one in XML, so this reader is a
+direct recursive translation into the shared AST — the XML counterpart of
+``distel_tpu.owl.parser``.  Reference parity: OWLAPI format auto-detection
+at ``init/AxiomLoader.java:127-136``.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Dict, Optional
+
+from distel_tpu.owl import syntax as S
+
+OWLX = "http://www.w3.org/2002/07/owl#"
+
+
+def _local(elem: ET.Element) -> str:
+    t = elem.tag
+    return t.split("}", 1)[1] if t.startswith("{") else t
+
+
+class _Reader:
+    def __init__(self, root: ET.Element):
+        self.root = root
+        self.prefixes: Dict[str, str] = {}
+        self.declared_individuals: set = set()
+        for el in root.iter():
+            loc = _local(el)
+            if loc == "Prefix":
+                self.prefixes[el.get("name", "")] = el.get("IRI", "")
+            elif loc == "Declaration":
+                for child in el:
+                    if _local(child) == "NamedIndividual":
+                        self.declared_individuals.add(self._iri(child))
+
+    def _iri(self, el: ET.Element) -> str:
+        iri = el.get("IRI")
+        if iri is not None:
+            return iri
+        abbrev = el.get("abbreviatedIRI", "")
+        if ":" in abbrev:
+            pfx, local = abbrev.split(":", 1)
+            base = self.prefixes.get(pfx)
+            if base is not None:
+                return base + local
+        return abbrev
+
+    # ------------------------------------------------------------ entities
+
+    def cls_expr(self, el: ET.Element) -> S.ClassExpression:
+        loc = _local(el)
+        if loc == "Class":
+            iri = self._iri(el)
+            if iri == f"{OWLX}Thing":
+                return S.OWL_THING
+            if iri == f"{OWLX}Nothing":
+                return S.OWL_NOTHING
+            if iri in self.declared_individuals:
+                return S.Individual(iri)
+            return S.Class(iri)
+        if loc == "ObjectIntersectionOf":
+            ops = tuple(self.cls_expr(c) for c in el)
+            return ops[0] if len(ops) == 1 else S.ObjectIntersectionOf(ops)
+        if loc == "ObjectSomeValuesFrom":
+            children = list(el)
+            return S.ObjectSomeValuesFrom(
+                S.ObjectProperty(self._iri(children[0])),
+                self.cls_expr(children[1]),
+            )
+        if loc == "ObjectOneOf":
+            return S.ObjectOneOf(
+                tuple(S.Individual(self._iri(c)) for c in el)
+            )
+        return S.UnsupportedClassExpression(loc)
+
+    # ------------------------------------------------------------- axioms
+
+    def axiom(self, el: ET.Element) -> Optional[S.Axiom]:
+        loc = _local(el)
+        ch = list(el)
+        # OWL/XML wraps each axiom's annotations first; skip them
+        ch = [c for c in ch if _local(c) != "Annotation"]
+        if loc == "SubClassOf":
+            return S.SubClassOf(self.cls_expr(ch[0]), self.cls_expr(ch[1]))
+        if loc == "EquivalentClasses":
+            return S.EquivalentClasses(tuple(self.cls_expr(c) for c in ch))
+        if loc == "DisjointClasses":
+            return S.DisjointClasses(tuple(self.cls_expr(c) for c in ch))
+        if loc == "SubObjectPropertyOf":
+            if _local(ch[0]) == "ObjectPropertyChain":
+                chain = tuple(S.ObjectProperty(self._iri(c)) for c in ch[0])
+            else:
+                chain = (S.ObjectProperty(self._iri(ch[0])),)
+            return S.SubObjectPropertyOf(chain, S.ObjectProperty(self._iri(ch[1])))
+        if loc == "EquivalentObjectProperties":
+            return S.EquivalentObjectProperties(
+                tuple(S.ObjectProperty(self._iri(c)) for c in ch)
+            )
+        if loc == "TransitiveObjectProperty":
+            return S.TransitiveObjectProperty(S.ObjectProperty(self._iri(ch[0])))
+        if loc == "ReflexiveObjectProperty":
+            return S.ReflexiveObjectProperty(S.ObjectProperty(self._iri(ch[0])))
+        if loc == "ObjectPropertyDomain":
+            return S.ObjectPropertyDomain(
+                S.ObjectProperty(self._iri(ch[0])), self.cls_expr(ch[1])
+            )
+        if loc == "ObjectPropertyRange":
+            return S.ObjectPropertyRange(
+                S.ObjectProperty(self._iri(ch[0])), self.cls_expr(ch[1])
+            )
+        if loc == "ClassAssertion":
+            return S.ClassAssertion(
+                self.cls_expr(ch[0]), S.Individual(self._iri(ch[1]))
+            )
+        if loc == "ObjectPropertyAssertion":
+            return S.ObjectPropertyAssertion(
+                S.ObjectProperty(self._iri(ch[0])),
+                S.Individual(self._iri(ch[1])),
+                S.Individual(self._iri(ch[2])),
+            )
+        if loc in ("Declaration", "Prefix", "Annotation", "AnnotationAssertion"):
+            return None
+        return S.UnsupportedAxiom(loc)
+
+    def read(self) -> S.Ontology:
+        onto = S.Ontology(iri=self.root.get("ontologyIRI", ""))
+        onto.prefixes.update(
+            {p + ":": iri for p, iri in self.prefixes.items() if p}
+        )
+        for el in self.root:
+            ax = self.axiom(el)
+            if ax is not None:
+                onto.add(ax)
+        return onto
+
+
+def parse(text: str) -> S.Ontology:
+    """OWL/XML document → Ontology over the shared EL AST."""
+    return _Reader(ET.fromstring(text)).read()
+
+
+def parse_file(path: str) -> S.Ontology:
+    with open(path, "r", encoding="utf-8") as f:
+        return parse(f.read())

@@ -20,7 +20,7 @@ from distel_tpu.config import ClassifierConfig
 from distel_tpu.core.engine import SaturationEngine, SaturationResult
 from distel_tpu.core.indexing import Indexer, IndexedOntology
 from distel_tpu.frontend.normalizer import Normalizer, NormalizedOntology
-from distel_tpu.owl import parser as owl_parser
+from distel_tpu.owl import loader as owl_loader
 from distel_tpu.runtime.instrumentation import PhaseTimer
 from distel_tpu.runtime.taxonomy import Taxonomy, extract_taxonomy
 
@@ -83,10 +83,16 @@ class ELClassifier:
         cfg = self.config
         norm = None
         idx = None
-        # fast path: C++ load plane (text → tensors, no Python AST);
+        fmt = owl_loader.detect_format(text)
+        # fast path: C++ load plane (OFN text → tensors, no Python AST);
         # the Python frontend remains the reference implementation and the
         # path the oracle verification (and gensym caching) runs through
-        if cfg.use_native_loader and not verify and not cfg.normalize_cache_path:
+        if (
+            cfg.use_native_loader
+            and fmt == "ofn"
+            and not verify
+            and not cfg.normalize_cache_path
+        ):
             from distel_tpu.owl import native_loader
 
             if native_loader.native_available():
@@ -94,7 +100,7 @@ class ELClassifier:
                     idx = native_loader.load_indexed(text)
         if idx is None:
             with timer.phase("parse"):
-                onto = owl_parser.parse(text)
+                onto = owl_loader.load(text)
             cache = None
             if cfg.normalize_cache_path:
                 try:

@@ -16,14 +16,14 @@ from typing import Dict
 import numpy as np
 
 from distel_tpu.core.engine import SaturationResult
-from distel_tpu.owl import parser, syntax as S
+from distel_tpu.owl import loader as owl_loader, syntax as S
 
 
 def ontology_stats(path_or_text: str) -> Dict:
     if "\n" in path_or_text:
-        onto = parser.parse(path_or_text)
+        onto = owl_loader.load(path_or_text)
     else:
-        onto = parser.parse_file(path_or_text)
+        onto = owl_loader.load_file(path_or_text)
     kinds = Counter(type(ax).__name__ for ax in onto.axioms)
     exprs = Counter()
     max_conj = 0

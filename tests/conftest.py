@@ -28,6 +28,11 @@ os.environ["PALLAS_AXON_POOL_IPS"] = ""  # subprocesses: no tunnel registration
 
 import jax  # noqa: E402
 
+# Import pallas while the tpu platform is still registered — its lowering
+# registration needs the platform name, and tests exercise the Pallas
+# interpreter on CPU.
+import jax.experimental.pallas  # noqa: E402,F401
+
 jax.config.update("jax_platforms", "cpu")
 try:
     import jax._src.xla_bridge as _xb

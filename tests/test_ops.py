@@ -1,0 +1,102 @@
+"""Bit-packed ops: pack/unpack/gather/scatter roundtrips and the packed
+AND-OR matmul (XLA fallback + Pallas interpreter) against numpy."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distel_tpu.ops.bitpack import (
+    ColumnScatter,
+    gather_bit_columns,
+    pack_bool_columns,
+    scatter_or_columns,
+    unpack_words,
+)
+from distel_tpu.ops.bitmatmul import (
+    PackedMatmulPlan,
+    contraction_bit_order,
+    packed_andor_matmul,
+)
+
+rng = np.random.default_rng(7)
+
+
+def test_pack_unpack_roundtrip():
+    x = rng.random((13, 96)) < 0.3
+    p = pack_bool_columns(jnp.asarray(x))
+    assert p.shape == (13, 3) and p.dtype == jnp.uint32
+    back = np.asarray(unpack_words(p, 96))
+    assert (back == x).all()
+
+
+def test_gather_bit_columns():
+    x = rng.random((9, 64)) < 0.4
+    p = pack_bool_columns(jnp.asarray(x))
+    cols = np.array([0, 5, 31, 32, 63, 5])
+    got = np.asarray(gather_bit_columns(p, cols))
+    assert (got == x[:, cols]).all()
+    assert gather_bit_columns(p, np.zeros(0, np.int64)).shape == (9, 0)
+
+
+def test_scatter_or_columns():
+    n, w = 11, 4
+    base = rng.random((n, w * 32)) < 0.2
+    packed = pack_bool_columns(jnp.asarray(base))
+    targets = np.array([3, 64, 3, 127, 64])     # duplicates on purpose
+    bits = rng.random((n, len(targets))) < 0.5
+    out = np.asarray(scatter_or_columns(packed, jnp.asarray(bits), targets))
+    expect = base.copy()
+    for j, t in enumerate(targets):
+        expect[:, t] |= bits[:, j]
+    assert (np.asarray(unpack_words(jnp.asarray(out), w * 32)) == expect).all()
+
+
+def test_column_scatter_empty():
+    p = pack_bool_columns(jnp.asarray(rng.random((5, 32)) < 0.5))
+    cs = ColumnScatter(np.zeros(0, np.int64), 1)
+    assert cs.apply(p, jnp.zeros((5, 0), bool)) is p
+
+
+def test_contraction_bit_order_is_permutation():
+    order = contraction_bit_order(256, 128)
+    assert sorted(order.tolist()) == list(range(256 * 32))
+    # position p*tkw + w inside tile k holds bit p of word k*tkw + w
+    assert order[0] == 0          # k=0, p=0, w=0 → word 0 bit 0
+    assert order[1] == 32         # k=0, p=0, w=1 → word 1 bit 0
+    assert order[128] == 1        # k=0, p=1, w=0 → word 0 bit 1
+
+
+@pytest.mark.parametrize("mode", ["xla", "interpret"])
+def test_packed_andor_matmul(mode):
+    M, K, N = 70, 300, 90
+    kw = (K + 31) // 32
+    a = rng.random((M, kw * 32)) < 0.1
+    a[:, K:] = False
+    b = rng.random((K, N)) < 0.05
+    c_ref = (a[:, :K].astype(np.float32) @ b.astype(np.float32)) > 0
+
+    ap = pack_bool_columns(jnp.asarray(a))
+    c = np.asarray(
+        packed_andor_matmul(
+            ap,
+            jnp.asarray(b, jnp.int8),
+            use_xla=(mode == "xla"),
+            interpret=(mode == "interpret"),
+        )
+    )
+    assert c.shape == (M, N)
+    assert (c.astype(bool) == c_ref).all()
+
+
+def test_packed_matmul_plan_kernel_order():
+    M, K, N = 40, 128, 33
+    kw = K // 32
+    a = rng.random((M, K)) < 0.2
+    b = rng.random((K, N)) < 0.1
+    plan = PackedMatmulPlan(M, kw, N, use_xla=True)
+    bk = np.zeros((plan.k_p, N), np.int8)
+    valid = plan.bit_order < K
+    bk[valid] = b[plan.bit_order[valid]]
+    c = np.asarray(plan(pack_bool_columns(jnp.asarray(a)), jnp.asarray(bk)))
+    c_ref = (a.astype(np.float32) @ b.astype(np.float32)) > 0
+    assert (c.astype(bool) == c_ref).all()
