@@ -53,6 +53,13 @@ class ClassifierConfig:
     #: use the C++ load plane (native/distel_loader.cpp) when available —
     #: ~13x faster text→tensors than the Python frontend
     use_native_loader: bool = True
+    #: state representation: "dense" (bool arrays, mesh-shardable),
+    #: "packed" (uint32 bitsets + Pallas kernels, ~8x the single-chip
+    #: concept ceiling), or "auto" (packed beyond auto_packed_threshold
+    #: concepts on a single device)
+    engine: str = "auto"
+    #: concept count above which "auto" switches to the packed engine
+    auto_packed_threshold: int = 16384
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -87,6 +94,10 @@ class ClassifierConfig:
             cfg.normalize_cache_path = raw["normalize.cache.path"]
         if "native.loader" in raw:
             cfg.use_native_loader = raw["native.loader"].lower() == "true"
+        if "engine" in raw:
+            cfg.engine = raw["engine"]
+        if "auto.packed.threshold" in raw:
+            cfg.auto_packed_threshold = int(raw["auto.packed.threshold"])
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v

@@ -1,0 +1,289 @@
+"""Bit-packed saturation engine: EL+ completion on uint32 bitset state.
+
+Same rule semantics as the dense engine (``core/engine.py`` — the spec is
+``core/oracle.py``), different state representation: S and R live as
+uint32 words, 32 concepts each, end to end — in HBM, through every rule,
+across the whole fixed point.  XLA's bool arrays burn a byte per bit, so
+packing multiplies the single-chip concept ceiling by ~8 and cuts the
+step's HBM traffic by the same factor (the usual TPU bottleneck;
+SURVEY.md §7 step 6).
+
+Rules map onto the packed ops (``distel_tpu/ops``):
+
+  CR1/CR2/CR3   gather_bit_columns → bool columns → ColumnScatter OR-packs
+                them back (the scatter-add trick: distinct (word,bit)
+                targets never carry)
+  CR4/CR6       PackedMatmulPlan — the Pallas MXU kernel contracting the
+                *packed* R against the per-step axiom operand
+                (reference: the CR4 two-stage join ``RolePairHandler.java:421-425``
+                and the chain join of ``base/Type5AxiomProcessorBase.java:99-153``)
+  CR5 (⊥)       one VPU pass: any(rp & botf_packed) per row
+
+The fixed-point loop, convergence vote, and derivation accounting mirror
+the dense engine (reference barrier AND-vote
+``controller/CommunicationHandler.java:78-83``).  Sharded-mesh execution
+stays with the dense engine for now — this engine is the single-chip
+scale path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distel_tpu.core.engine import (
+    SaturationResult,
+    _host_bit_total,
+    _pad_up,
+)
+from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
+from distel_tpu.ops.bitmatmul import PackedMatmulPlan
+from distel_tpu.ops.bitpack import ColumnScatter, gather_bit_columns
+
+
+class PackedSaturationEngine:
+    """Compiles an indexed ontology into a jitted fixed point over packed
+    state.  API mirrors ``SaturationEngine`` for the paths the runtime
+    uses: ``initial_state`` / ``step`` / ``saturate``."""
+
+    def __init__(
+        self,
+        idx: IndexedOntology,
+        *,
+        pad_multiple: int = 128,
+        matmul_dtype=None,
+        unroll: int = 4,
+        use_pallas: Optional[bool] = None,
+    ):
+        self.idx = idx
+        self.unroll = max(int(unroll), 1)
+        pad_multiple = _pad_up(max(pad_multiple, 32), 32)
+        self.nc = _pad_up(max(idx.n_concepts, 2), pad_multiple)
+        self.nl = max(_pad_up(idx.n_links, 32), 32)
+        self.wc = self.nc // 32
+        self.wl = self.nl // 32
+
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        kw = {} if matmul_dtype is None else {"dtype": matmul_dtype}
+        k4 = len(idx.nf4)
+        p6 = len(idx.chain_pairs)
+        self._plan4 = (
+            PackedMatmulPlan(self.nc, self.wl, k4, use_xla=not use_pallas, **kw)
+            if k4
+            else None
+        )
+        self._plan6 = (
+            PackedMatmulPlan(self.nc, self.wl, p6, use_xla=not use_pallas, **kw)
+            if p6
+            else None
+        )
+
+        h = idx.role_closure
+        link_roles = (
+            idx.links[:, 0] if idx.n_links else np.zeros(0, np.int64)
+        )
+        fillers = np.zeros(self.nl, np.int64)
+        if idx.n_links:
+            fillers[: idx.n_links] = idx.links[:, 1]
+
+        # static per-rule index/mask tables, laid out in each matmul plan's
+        # kernel contraction order (ops/bitmatmul.py docstring) so nothing
+        # is permuted at runtime
+        def kernel_tables(plan):
+            order = plan.bit_order                       # [k_p] link ids
+            valid = order < idx.n_links
+            f = np.where(valid, fillers[np.minimum(order, self.nl - 1)], 0)
+            roles = np.where(valid, link_roles[np.minimum(order, max(idx.n_links - 1, 0))], 0)
+            return f.astype(np.int32), roles, valid
+
+        if self._plan4 is not None:
+            f4, roles4, valid4 = kernel_tables(self._plan4)
+            self._fillers4 = f4
+            # M4[rho, j] = valid(rho) & H[role(rho), s_j]
+            self._m4 = (valid4[:, None] & h[roles4][:, idx.nf4[:, 0]]).astype(
+                np.int8
+            )
+        if self._plan6 is not None:
+            f6, roles6, valid6 = kernel_tables(self._plan6)
+            self._fillers6 = f6
+            self._m6 = (
+                valid6[:, None] & h[roles6][:, idx.chain_pairs[:, 0]]
+            ).astype(np.int8)
+
+        # plain-layout filler rows for the ⊥ rule
+        self._fillers = fillers.astype(np.int32)
+        self._live_row = None  # built lazily inside jit
+
+        # scatter plans: one per state matrix, combining every rule that
+        # writes it (reference: the per-rule Lua writers of
+        # misc/ScriptsCollection.java collapsed into two scatters)
+        s_targets = [idx.nf1[:, 1], idx.nf2[:, 2]]
+        if len(idx.nf4):
+            s_targets.append(idx.nf4[:, 2])
+        if idx.has_bottom_axioms and idx.n_links:
+            s_targets.append(np.array([BOTTOM_ID]))
+        self._s_scatter = ColumnScatter(
+            np.concatenate(s_targets) if s_targets else np.zeros(0, np.int64),
+            self.wc,
+        )
+        r_targets = [idx.nf3[:, 1]]
+        if p6:
+            r_targets.append(idx.chain_pairs[:, 2])
+        self._r_scatter = ColumnScatter(np.concatenate(r_targets), self.wl)
+
+        self._step_jit = jax.jit(self._step)
+        self._initial_jit = None
+        self._run_jit = jax.jit(self._run, static_argnums=(2,))
+
+    # ------------------------------------------------------------- state
+
+    def _initial_arrays(self) -> Tuple[jax.Array, jax.Array]:
+        """S(X) = {X, ⊤}, R empty — packed form of the reference's init
+        (``init/AxiomLoader.java:1237-1245``)."""
+        rows = jnp.arange(self.nc)
+        sp = jnp.zeros((self.nc, self.wc), jnp.uint32)
+        sp = sp.at[rows, rows >> 5].set(
+            jnp.asarray(1, jnp.uint32) << (rows & 31).astype(jnp.uint32)
+        )
+        top = jnp.asarray(np.uint32(1 << (TOP_ID & 31)))
+        sp = sp.at[:, TOP_ID >> 5].set(sp[:, TOP_ID >> 5] | top)
+        rp = jnp.zeros((self.nc, self.wl), jnp.uint32)
+        return sp, rp
+
+    def initial_state(self) -> Tuple[jax.Array, jax.Array]:
+        if self._initial_jit is None:
+            self._initial_jit = jax.jit(self._initial_arrays)
+        return self._initial_jit()
+
+    # ------------------------------------------------------------- rules
+
+    def _step(self, sp: jax.Array, rp: jax.Array):
+        idx = self.idx
+        s_sources = []
+        # CR1: a ⊑ b
+        s_sources.append(gather_bit_columns(sp, idx.nf1[:, 0]))
+        # CR2: a1 ⊓ a2 ⊑ b
+        s_sources.append(
+            gather_bit_columns(sp, idx.nf2[:, 0])
+            & gather_bit_columns(sp, idx.nf2[:, 1])
+        )
+        # CR3: a ⊑ ∃link
+        r_sources = [gather_bit_columns(sp, idx.nf3[:, 0])]
+        # CR4: ∃s.a ⊑ b — packed MXU matmul over the link axis
+        if self._plan4 is not None:
+            sf = gather_bit_columns(sp[self._fillers4], idx.nf4[:, 1])
+            w4 = jnp.asarray(self._m4) * sf.astype(jnp.int8)
+            s_sources.append(self._plan4(rp, w4).astype(bool))
+        # CR6: chains — same kernel over precomputed chain pairs
+        if self._plan6 is not None:
+            rf = gather_bit_columns(rp[self._fillers6], idx.chain_pairs[:, 1])
+            d6 = jnp.asarray(self._m6) * rf.astype(jnp.int8)
+            r_sources.append(self._plan6(rp, d6).astype(bool))
+        # CR5: ⊥ back-propagation — one AND+any pass over packed words
+        if idx.has_bottom_axioms and idx.n_links:
+            botf = gather_bit_columns(
+                sp[self._fillers], np.full(1, BOTTOM_ID)
+            )[:, 0]
+            # pack the [nl] bool vector: scatter-ADD of distinct powers of
+            # two per word is bitwise OR (no carries)
+            links = jnp.arange(self.nl)
+            botf_packed = (
+                jnp.zeros((1, self.wl), jnp.uint32)
+                .at[0, links >> 5]
+                .add(
+                    botf.astype(jnp.uint32)
+                    << (links & 31).astype(jnp.uint32)
+                )
+            )
+            newbot = jnp.any(rp & botf_packed != 0, axis=1)
+            s_sources.append(newbot[:, None])
+
+        sp = self._s_scatter.apply(sp, jnp.concatenate(s_sources, axis=1))
+        rp = self._r_scatter.apply(rp, jnp.concatenate(r_sources, axis=1))
+        return sp, rp
+
+    def step(self, sp, rp):
+        return self._step_jit(sp, rp)
+
+    # -------------------------------------------------------- fixed point
+
+    def _live_bits(self, sp: jax.Array, rp: jax.Array) -> jax.Array:
+        live = jnp.arange(self.nc) < self.idx.n_concepts
+        pop = jnp.sum(
+            lax.population_count(sp), axis=1, dtype=jnp.int32
+        ) + jnp.sum(lax.population_count(rp), axis=1, dtype=jnp.int32)
+        return jnp.where(live, pop, 0)
+
+    def _run(self, sp0, rp0, max_iters: int):
+        unroll = self.unroll
+
+        def cond(st):
+            sp, rp, it, changed = st
+            return changed & (it < max_iters)
+
+        def body(st):
+            sp, rp, it, _ = st
+            sp2, rp2 = sp, rp
+            for _ in range(unroll):
+                sp2, rp2 = self._step(sp2, rp2)
+            changed = jnp.any(sp2 != sp) | jnp.any(rp2 != rp)
+            return (sp2, rp2, it + unroll, changed)
+
+        init_bits = self._live_bits(sp0, rp0)
+        sp, rp, it, changed = lax.while_loop(
+            cond, body, (sp0, rp0, jnp.asarray(0, jnp.int32), jnp.asarray(True))
+        )
+        return sp, rp, it, changed, self._live_bits(sp, rp), init_bits
+
+    def saturate(
+        self,
+        max_iters: int = 10_000,
+        *,
+        initial: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        allow_incomplete: bool = False,
+    ) -> SaturationResult:
+        budget = _pad_up(max_iters, self.unroll)
+        if initial is None:
+            sp0, rp0 = self.initial_state()
+        else:
+            sp0, rp0 = self.embed_state(*initial)
+        out = self._run_jit(sp0, rp0, budget)
+        sp, rp, it, changed, bits, init_bits = jax.device_get(out)
+        converged = not bool(changed)
+        if not converged and not allow_incomplete:
+            raise RuntimeError(
+                f"saturation did not converge within {budget} iterations"
+            )
+        return SaturationResult(
+            packed_s=sp,
+            packed_r=rp,
+            iterations=int(it),
+            derivations=_host_bit_total(bits) - _host_bit_total(init_bits),
+            idx=self.idx,
+            converged=converged,
+        )
+
+    def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:
+        """Embed an *unpacked* bool state (e.g. from a snapshot) into this
+        engine's packed arrays — the incremental/resume path."""
+        s_old = np.asarray(s_old, bool)
+        r_old = np.asarray(r_old, bool)
+        s = np.zeros((self.nc, self.nc), bool)
+        np.fill_diagonal(s, True)
+        s[:, TOP_ID] = True
+        nn = min(s_old.shape[0], self.nc)
+        s[:nn, : min(s_old.shape[1], self.nc)] |= s_old[
+            :nn, : min(s_old.shape[1], self.nc)
+        ]
+        r = np.zeros((self.nc, self.nl), bool)
+        r[:nn, : min(r_old.shape[1], self.nl)] = r_old[
+            :nn, : min(r_old.shape[1], self.nl)
+        ]
+        sp = np.packbits(s, axis=1, bitorder="little").view(np.uint32)
+        rp = np.packbits(r, axis=1, bitorder="little").view(np.uint32)
+        return jnp.asarray(sp), jnp.asarray(rp)

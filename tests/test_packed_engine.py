@@ -1,0 +1,111 @@
+"""Packed bitset engine: bit-identical to the dense engine and the CPU
+oracle across every rule (CR1-CR6, ⊥, domain/range), plus resume and
+classifier integration."""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.engine import SaturationEngine
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.packed_engine import PackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import synthetic_ontology
+from distel_tpu.owl import parser
+from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+BOTTOM_ONTO = """
+SubClassOf(Cat Mammal)
+SubClassOf(Mammal Animal)
+EquivalentClasses(Feline Cat)
+SubClassOf(Cat ObjectSomeValuesFrom(hasParent Cat))
+SubClassOf(ObjectSomeValuesFrom(hasParent Animal) Animal)
+DisjointClasses(Cat Dog)
+SubClassOf(CatDog Cat)
+SubClassOf(CatDog Dog)
+SubClassOf(Kitten ObjectSomeValuesFrom(hasParent CatDog))
+SubObjectPropertyOf(hasParent hasAncestor)
+SubObjectPropertyOf(ObjectPropertyChain(hasAncestor hasAncestor) hasAncestor)
+ObjectPropertyDomain(hasParent Animal)
+ObjectPropertyRange(hasParent Animal)
+TransitiveObjectProperty(partOf)
+SubClassOf(Paw ObjectSomeValuesFrom(partOf Leg))
+SubClassOf(Leg ObjectSomeValuesFrom(partOf Body))
+SubClassOf(ObjectSomeValuesFrom(partOf Body) BodyPart)
+"""
+
+
+def _indexed(text):
+    norm = normalize(parser.parse(text))
+    return norm, index_ontology(norm)
+
+
+@pytest.fixture(scope="module")
+def small():
+    return _indexed(BOTTOM_ONTO)
+
+
+def test_packed_matches_dense_all_rules(small):
+    norm, idx = small
+    dense = SaturationEngine(idx).saturate()
+    packed = PackedSaturationEngine(idx).saturate()
+    n, nl = idx.n_concepts, idx.n_links
+    assert packed.iterations == dense.iterations
+    assert packed.derivations == dense.derivations
+    assert (packed.s[:n, :n] == dense.s[:n, :n]).all()
+    assert (packed.r[:n, :nl] == dense.r[:n, :nl]).all()
+    # ⊥ propagated: Kitten has a CatDog parent, so Kitten is unsat too
+    unsat = {idx.concept_names[i] for i in packed.unsatisfiable()}
+    assert {"CatDog", "Kitten"} <= unsat
+
+
+def test_packed_matches_oracle(small):
+    norm, idx = small
+    report = diff_engine_vs_oracle(norm, PackedSaturationEngine(idx).saturate())
+    assert report.ok(), report.summary()
+
+
+def test_packed_matches_dense_synthetic():
+    norm, idx = _indexed(
+        synthetic_ontology(
+            n_classes=300, n_anatomy=50, n_locations=35, n_definitions=20
+        )
+    )
+    dense = SaturationEngine(idx).saturate()
+    packed = PackedSaturationEngine(idx).saturate()
+    n = idx.n_concepts
+    assert packed.derivations == dense.derivations
+    assert (packed.s[:n, :n] == dense.s[:n, :n]).all()
+
+
+def test_packed_resume_from_snapshot(small):
+    norm, idx = small
+    eng = PackedSaturationEngine(idx)
+    full = eng.saturate()
+    # resume from the converged state: zero new derivations, same closure
+    again = eng.saturate(initial=(full.s, full.r))
+    assert again.derivations == 0
+    assert (again.s == full.s).all()
+
+
+def test_packed_no_links_ontology():
+    norm, idx = _indexed("SubClassOf(A B)\nSubClassOf(B C)")
+    packed = PackedSaturationEngine(idx).saturate()
+    a = idx.concept_ids["A"]
+    c = idx.concept_ids["C"]
+    assert c in packed.subsumers(a)
+
+
+def test_classifier_engine_selection():
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.classifier import ELClassifier
+
+    cfg = ClassifierConfig(engine="packed", use_native_loader=False)
+    res = ELClassifier(cfg).classify_text(BOTTOM_ONTO)
+    assert "CatDog" in res.taxonomy.unsatisfiable
+    cfg2 = ClassifierConfig(engine="auto", auto_packed_threshold=1)
+    res2 = ELClassifier(cfg2).classify_text(BOTTOM_ONTO)
+    assert res2.result.derivations == res.result.derivations
+    with pytest.raises(ValueError):
+        ELClassifier(
+            ClassifierConfig(engine="packed", mesh_devices=2)
+        ).classify_text(BOTTOM_ONTO)
