@@ -21,13 +21,24 @@ Rules map onto the packed ops (``distel_tpu/ops``):
 
 The fixed-point loop, convergence vote, and derivation accounting mirror
 the dense engine (reference barrier AND-vote
-``controller/CommunicationHandler.java:78-83``).  Sharded-mesh execution
-stays with the dense engine for now — this engine is the single-chip
-scale path.
+``controller/CommunicationHandler.java:78-83``).
+
+Sharded execution (``mesh=``): S and R rows are sharded over the concept
+axis of the mesh and the whole fixed point runs inside one ``shard_map``.
+Each step all-reduces only the **distinct existential-filler rows** of
+S/R (the finite set of concepts that ever appear as a link filler —
+typically a small fraction of the concept universe), the packed analog of
+the reference's cross-node delta reads against the result node
+(``base/Type2AxiomProcessorBase.java:101-116``); everything else —
+column gathers, scatters, the MXU matmuls — is shard-local.  The
+convergence vote is a ``psum`` inside the ``lax.while_loop`` cond — the
+reference's Redis BLPOP barrier + AND-vote
+(``controller/CommunicationHandler.java:49-84``) as one ICI collective.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -42,13 +53,18 @@ from distel_tpu.core.engine import (
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.ops.bitmatmul import PackedMatmulPlan
-from distel_tpu.ops.bitpack import ColumnScatter, gather_bit_columns
+from distel_tpu.ops.bitpack import (
+    ColumnScatter,
+    gather_bit_columns,
+    gather_bit_matrix,
+)
 
 
 class PackedSaturationEngine:
     """Compiles an indexed ontology into a jitted fixed point over packed
     state.  API mirrors ``SaturationEngine`` for the paths the runtime
-    uses: ``initial_state`` / ``step`` / ``saturate``."""
+    uses: ``initial_state`` / ``step`` / ``saturate``; pass ``mesh=`` for
+    concept-axis row sharding (see module docstring)."""
 
     def __init__(
         self,
@@ -58,28 +74,39 @@ class PackedSaturationEngine:
         matmul_dtype=None,
         unroll: int = 4,
         use_pallas: Optional[bool] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        concept_axis: str = "c",
     ):
         self.idx = idx
         self.unroll = max(int(unroll), 1)
-        pad_multiple = _pad_up(max(pad_multiple, 32), 32)
+        self.mesh = mesh
+        self.concept_axis = concept_axis
+        self.n_shards = int(mesh.shape[concept_axis]) if mesh is not None else 1
+        pad_multiple = _pad_up(max(pad_multiple, 32), 32) * self.n_shards
         self.nc = _pad_up(max(idx.n_concepts, 2), pad_multiple)
         self.nl = max(_pad_up(idx.n_links, 32), 32)
         self.wc = self.nc // 32
         self.wl = self.nl // 32
+        self.rows_per_shard = self.nc // self.n_shards
 
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
         kw = {} if matmul_dtype is None else {"dtype": matmul_dtype}
         k4 = len(idx.nf4)
         p6 = len(idx.chain_pairs)
+        # plan m = the shard-local row count: the matmuls run inside
+        # shard_map on local blocks
+        m = self.rows_per_shard
+        # CR4/CR6 can only fire over existing links; without links the
+        # kernel tables below have nothing to index (and R stays empty)
         self._plan4 = (
-            PackedMatmulPlan(self.nc, self.wl, k4, use_xla=not use_pallas, **kw)
-            if k4
+            PackedMatmulPlan(m, self.wl, k4, use_xla=not use_pallas, **kw)
+            if k4 and idx.n_links
             else None
         )
         self._plan6 = (
-            PackedMatmulPlan(self.nc, self.wl, p6, use_xla=not use_pallas, **kw)
-            if p6
+            PackedMatmulPlan(m, self.wl, p6, use_xla=not use_pallas, **kw)
+            if p6 and idx.n_links
             else None
         )
 
@@ -91,6 +118,14 @@ class PackedSaturationEngine:
         if idx.n_links:
             fillers[: idx.n_links] = idx.links[:, 1]
 
+        # the distinct filler universe: the only rows of S/R any rule reads
+        # non-locally.  dindex maps concept id → distinct-row position.
+        self._distinct_fillers = (
+            np.unique(idx.links[:, 1]) if idx.n_links else np.zeros(0, np.int64)
+        ).astype(np.int32)
+        dindex = np.zeros(self.nc, np.int64)
+        dindex[self._distinct_fillers] = np.arange(len(self._distinct_fillers))
+
         # static per-rule index/mask tables, laid out in each matmul plan's
         # kernel contraction order (ops/bitmatmul.py docstring) so nothing
         # is permuted at runtime
@@ -99,25 +134,24 @@ class PackedSaturationEngine:
             valid = order < idx.n_links
             f = np.where(valid, fillers[np.minimum(order, self.nl - 1)], 0)
             roles = np.where(valid, link_roles[np.minimum(order, max(idx.n_links - 1, 0))], 0)
-            return f.astype(np.int32), roles, valid
+            return dindex[f], roles, valid
 
         if self._plan4 is not None:
-            f4, roles4, valid4 = kernel_tables(self._plan4)
-            self._fillers4 = f4
+            d4, roles4, valid4 = kernel_tables(self._plan4)
+            self._drows4 = d4
             # M4[rho, j] = valid(rho) & H[role(rho), s_j]
             self._m4 = (valid4[:, None] & h[roles4][:, idx.nf4[:, 0]]).astype(
                 np.int8
             )
         if self._plan6 is not None:
-            f6, roles6, valid6 = kernel_tables(self._plan6)
-            self._fillers6 = f6
+            d6, roles6, valid6 = kernel_tables(self._plan6)
+            self._drows6 = d6
             self._m6 = (
                 valid6[:, None] & h[roles6][:, idx.chain_pairs[:, 0]]
             ).astype(np.int8)
 
-        # plain-layout filler rows for the ⊥ rule
-        self._fillers = fillers.astype(np.int32)
-        self._live_row = None  # built lazily inside jit
+        # distinct-row position of every (plain-layout) link filler, for ⊥
+        self._dplain = dindex[fillers]
 
         # scatter plans: one per state matrix, combining every rule that
         # writes it (reference: the per-rule Lua writers of
@@ -136,9 +170,18 @@ class PackedSaturationEngine:
             r_targets.append(idx.chain_pairs[:, 2])
         self._r_scatter = ColumnScatter(np.concatenate(r_targets), self.wl)
 
+        if mesh is not None:
+            P = jax.sharding.PartitionSpec
+            ns = jax.sharding.NamedSharding
+            self._row_sharding = ns(mesh, P(concept_axis, None))
+        else:
+            self._row_sharding = None
         self._step_jit = jax.jit(self._step)
         self._initial_jit = None
-        self._run_jit = jax.jit(self._run, static_argnums=(2,))
+        if mesh is None:
+            self._run_jit = jax.jit(self._run, static_argnums=(2,))
+        else:
+            self._run_jit = functools.lru_cache(maxsize=4)(self._sharded_run)
 
     # ------------------------------------------------------------- state
 
@@ -157,13 +200,47 @@ class PackedSaturationEngine:
 
     def initial_state(self) -> Tuple[jax.Array, jax.Array]:
         if self._initial_jit is None:
-            self._initial_jit = jax.jit(self._initial_arrays)
+            out_shardings = (
+                None
+                if self._row_sharding is None
+                else (self._row_sharding, self._row_sharding)
+            )
+            self._initial_jit = jax.jit(
+                self._initial_arrays, out_shardings=out_shardings
+            )
         return self._initial_jit()
 
     # ------------------------------------------------------------- rules
 
-    def _step(self, sp: jax.Array, rp: jax.Array):
+    def _filler_rows(self, x_loc: jax.Array, axis_name: Optional[str]):
+        """The distinct-filler rows of the (possibly shard-local) packed
+        matrix ``x_loc``, replicated: the only cross-shard reads of the
+        whole step.  Each row lives on exactly one shard, so the masked
+        gather + psum IS the row exchange — one all-reduce over ICI."""
+        rows = self._distinct_fillers
+        if axis_name is None:
+            return x_loc[rows]
+        i = lax.axis_index(axis_name)
+        local = jnp.asarray(rows) - i * self.rows_per_shard
+        ok = (local >= 0) & (local < self.rows_per_shard)
+        part = jnp.where(
+            ok[:, None],
+            x_loc[jnp.clip(local, 0, self.rows_per_shard - 1)],
+            jnp.asarray(0, x_loc.dtype),
+        )
+        return lax.psum(part, axis_name)
+
+    def _step(
+        self,
+        sp: jax.Array,
+        rp: jax.Array,
+        axis_name: Optional[str] = None,
+    ):
         idx = self.idx
+        need_s_rows = self._plan4 is not None or (
+            idx.has_bottom_axioms and idx.n_links
+        )
+        sf_rows = self._filler_rows(sp, axis_name) if need_s_rows else None
         s_sources = []
         # CR1: a ⊑ b
         s_sources.append(gather_bit_columns(sp, idx.nf1[:, 0]))
@@ -176,19 +253,19 @@ class PackedSaturationEngine:
         r_sources = [gather_bit_columns(sp, idx.nf3[:, 0])]
         # CR4: ∃s.a ⊑ b — packed MXU matmul over the link axis
         if self._plan4 is not None:
-            sf = gather_bit_columns(sp[self._fillers4], idx.nf4[:, 1])
+            sf = gather_bit_matrix(sf_rows, self._drows4, idx.nf4[:, 1])
             w4 = jnp.asarray(self._m4) * sf.astype(jnp.int8)
             s_sources.append(self._plan4(rp, w4).astype(bool))
         # CR6: chains — same kernel over precomputed chain pairs
         if self._plan6 is not None:
-            rf = gather_bit_columns(rp[self._fillers6], idx.chain_pairs[:, 1])
+            rf_rows = self._filler_rows(rp, axis_name)
+            rf = gather_bit_matrix(rf_rows, self._drows6, idx.chain_pairs[:, 1])
             d6 = jnp.asarray(self._m6) * rf.astype(jnp.int8)
             r_sources.append(self._plan6(rp, d6).astype(bool))
         # CR5: ⊥ back-propagation — one AND+any pass over packed words
         if idx.has_bottom_axioms and idx.n_links:
-            botf = gather_bit_columns(
-                sp[self._fillers], np.full(1, BOTTOM_ID)
-            )[:, 0]
+            botd = gather_bit_columns(sf_rows, np.full(1, BOTTOM_ID))[:, 0]
+            botf = botd[self._dplain]                    # [nl] bool
             # pack the [nl] bool vector: scatter-ADD of distinct powers of
             # two per word is bitwise OR (no carries)
             links = jnp.arange(self.nl)
@@ -212,14 +289,22 @@ class PackedSaturationEngine:
 
     # -------------------------------------------------------- fixed point
 
-    def _live_bits(self, sp: jax.Array, rp: jax.Array) -> jax.Array:
-        live = jnp.arange(self.nc) < self.idx.n_concepts
+    def _live_bits(
+        self, sp: jax.Array, rp: jax.Array, axis_name: Optional[str] = None
+    ) -> jax.Array:
+        n_local = sp.shape[0]
+        rows = jnp.arange(n_local)
+        if axis_name is not None:
+            rows = rows + lax.axis_index(axis_name) * n_local
+        live = rows < self.idx.n_concepts
         pop = jnp.sum(
             lax.population_count(sp), axis=1, dtype=jnp.int32
         ) + jnp.sum(lax.population_count(rp), axis=1, dtype=jnp.int32)
         return jnp.where(live, pop, 0)
 
-    def _run(self, sp0, rp0, max_iters: int):
+    def _run(
+        self, sp0, rp0, max_iters: int, axis_name: Optional[str] = None
+    ):
         unroll = self.unroll
 
         def cond(st):
@@ -230,15 +315,58 @@ class PackedSaturationEngine:
             sp, rp, it, _ = st
             sp2, rp2 = sp, rp
             for _ in range(unroll):
-                sp2, rp2 = self._step(sp2, rp2)
+                sp2, rp2 = self._step(sp2, rp2, axis_name)
             changed = jnp.any(sp2 != sp) | jnp.any(rp2 != rp)
+            if axis_name is not None:
+                # the reference's global AND-vote
+                # (controller/CommunicationHandler.java:78-83) as one psum
+                changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
             return (sp2, rp2, it + unroll, changed)
 
-        init_bits = self._live_bits(sp0, rp0)
+        init_bits = self._live_bits(sp0, rp0, axis_name)
         sp, rp, it, changed = lax.while_loop(
             cond, body, (sp0, rp0, jnp.asarray(0, jnp.int32), jnp.asarray(True))
         )
-        return sp, rp, it, changed, self._live_bits(sp, rp), init_bits
+        return sp, rp, it, changed, self._live_bits(sp, rp, axis_name), init_bits
+
+    def _sharded_run(self, max_iters: int):
+        """Build (and cache per iteration budget) the jitted shard_map of
+        the whole fixed point."""
+        P = jax.sharding.PartitionSpec
+        axis = self.concept_axis
+
+        def run(sp0, rp0):
+            sp, rp, it, changed, bits, init_bits = self._run(
+                sp0, rp0, max_iters, axis
+            )
+            # scalars leave the shard_map as one lane per shard (their
+            # values are replicated by construction — psum'd vote,
+            # lockstep counter)
+            return (
+                sp,
+                rp,
+                it[None],
+                changed[None],
+                bits,
+                init_bits,
+            )
+
+        return jax.jit(
+            jax.shard_map(
+                run,
+                mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis, None)),
+                out_specs=(
+                    P(axis, None),
+                    P(axis, None),
+                    P(axis),
+                    P(axis),
+                    P(axis),
+                    P(axis),
+                ),
+                check_vma=False,
+            )
+        )
 
     def saturate(
         self,
@@ -252,8 +380,12 @@ class PackedSaturationEngine:
             sp0, rp0 = self.initial_state()
         else:
             sp0, rp0 = self.embed_state(*initial)
-        out = self._run_jit(sp0, rp0, budget)
+        if self.mesh is None:
+            out = self._run_jit(sp0, rp0, budget)
+        else:
+            out = self._run_jit(budget)(sp0, rp0)
         sp, rp, it, changed, bits, init_bits = jax.device_get(out)
+        it, changed = np.max(it), np.max(changed)
         converged = not bool(changed)
         if not converged and not allow_incomplete:
             raise RuntimeError(
@@ -286,4 +418,9 @@ class PackedSaturationEngine:
         ]
         sp = np.packbits(s, axis=1, bitorder="little").view(np.uint32)
         rp = np.packbits(r, axis=1, bitorder="little").view(np.uint32)
+        if self._row_sharding is not None:
+            return (
+                jax.device_put(sp, self._row_sharding),
+                jax.device_put(rp, self._row_sharding),
+            )
         return jnp.asarray(sp), jnp.asarray(rp)

@@ -44,6 +44,20 @@ def gather_bit_columns(p, cols: np.ndarray) -> jnp.ndarray:
     return ((words >> shifts) & jnp.asarray(1, jnp.uint32)).astype(bool)
 
 
+def gather_bit_matrix(p, rows: np.ndarray, cols: np.ndarray) -> jnp.ndarray:
+    """Extract the bool matrix ``out[i, j] = bit(p[rows[i], cols[j]])`` from
+    packed ``p`` [N, W] → bool [len(rows), len(cols)].  Both index vectors
+    are static, so the word/bit split is free and the two gathers fuse —
+    no [len(rows), W] row intermediate is materialized."""
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    if rows.size == 0 or cols.size == 0:
+        return jnp.zeros((rows.size, cols.size), bool)
+    words = p[rows[:, None], (cols >> 5)[None, :]]
+    shifts = jnp.asarray((cols & 31).astype(np.uint32))[None, :]
+    return ((words >> shifts) & jnp.asarray(1, jnp.uint32)).astype(bool)
+
+
 class ColumnScatter:
     """Static plan for OR-scattering source bit vectors into packed columns.
 

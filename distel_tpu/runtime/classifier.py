@@ -77,28 +77,28 @@ class ELClassifier:
             self._mesh = jax.sharding.Mesh(np.array(devs[:n]), ("c",))
 
     def _make_engine(self, idx: IndexedOntology):
-        """Engine selection: the packed bitset engine lifts the single-chip
-        concept ceiling ~8x; the dense engine is the mesh-shardable path."""
+        """Engine selection: the packed bitset engine (single-chip or
+        row-sharded over the mesh) lifts the concept ceiling ~8x; the dense
+        engine remains the simplest-possible reference path."""
         cfg = self.config
         choice = cfg.engine
         if choice == "auto":
             choice = (
                 "packed"
-                if self._mesh is None
-                and idx.n_concepts > cfg.auto_packed_threshold
+                if idx.n_concepts > cfg.auto_packed_threshold
                 else "dense"
             )
+        if choice not in ("packed", "dense"):
+            raise ValueError(
+                f"unknown engine {cfg.engine!r}: expected 'auto', 'packed' or 'dense'"
+            )
         if choice == "packed":
-            if self._mesh is not None:
-                raise ValueError(
-                    "engine='packed' does not shard over a mesh yet; "
-                    "use engine='dense' with mesh_devices"
-                )
             from distel_tpu.core.packed_engine import PackedSaturationEngine
 
             return PackedSaturationEngine(
                 idx,
                 pad_multiple=cfg.pad_multiple,
+                mesh=self._mesh,
                 matmul_dtype=cfg.matmul_jnp_dtype(),
             )
         return SaturationEngine(

@@ -95,6 +95,28 @@ def test_packed_no_links_ontology():
     assert c in packed.subsumers(a)
 
 
+def test_packed_nf4_without_links():
+    # ∃r.A ⊑ B axioms but no A ⊑ ∃r.B producers: the link table is empty
+    # and CR4 can never fire — must construct and run, not crash
+    norm, idx = _indexed(
+        "SubClassOf(ObjectSomeValuesFrom(hasParent Animal) Animal)\n"
+        "SubClassOf(A B)"
+    )
+    assert idx.n_links == 0 and len(idx.nf4) > 0
+    packed = PackedSaturationEngine(idx).saturate()
+    assert idx.concept_ids["B"] in packed.subsumers(idx.concept_ids["A"])
+
+
+def test_classifier_rejects_unknown_engine():
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.classifier import ELClassifier
+
+    with pytest.raises(ValueError, match="unknown engine"):
+        ELClassifier(ClassifierConfig(engine="Packed")).classify_text(
+            "SubClassOf(A B)"
+        )
+
+
 def test_classifier_engine_selection():
     from distel_tpu.config import ClassifierConfig
     from distel_tpu.runtime.classifier import ELClassifier
@@ -105,7 +127,61 @@ def test_classifier_engine_selection():
     cfg2 = ClassifierConfig(engine="auto", auto_packed_threshold=1)
     res2 = ELClassifier(cfg2).classify_text(BOTTOM_ONTO)
     assert res2.result.derivations == res.result.derivations
-    with pytest.raises(ValueError):
-        ELClassifier(
-            ClassifierConfig(engine="packed", mesh_devices=2)
-        ).classify_text(BOTTOM_ONTO)
+
+
+# ----------------------------------------------------- mesh-sharded path
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices (see conftest.py)")
+    return jax.sharding.Mesh(np.array(jax.devices()[:8]), ("c",))
+
+
+def test_sharded_packed_matches_local_all_rules(small, mesh8):
+    norm, idx = small
+    local = PackedSaturationEngine(idx).saturate()
+    sharded = PackedSaturationEngine(idx, mesh=mesh8).saturate()
+    assert sharded.derivations == local.derivations
+    n, nl = idx.n_concepts, idx.n_links
+    assert (sharded.s[:n, :n] == local.s[:n, :n]).all()
+    assert (sharded.r[:n, :nl] == local.r[:n, :nl]).all()
+    report = diff_engine_vs_oracle(norm, sharded)
+    assert report.ok(), report.summary()
+
+
+def test_sharded_packed_synthetic(mesh8):
+    norm, idx = _indexed(
+        synthetic_ontology(
+            n_classes=300, n_anatomy=50, n_locations=35, n_definitions=20
+        )
+    )
+    local = PackedSaturationEngine(idx).saturate()
+    sharded = PackedSaturationEngine(idx, mesh=mesh8).saturate()
+    assert sharded.derivations == local.derivations
+    n = idx.n_concepts
+    assert (sharded.s[:n, :n] == local.s[:n, :n]).all()
+
+
+def test_sharded_packed_state_is_sharded(mesh8):
+    norm, idx = _indexed(BOTTOM_ONTO)
+    eng = PackedSaturationEngine(idx, mesh=mesh8)
+    sp, rp = eng.initial_state()
+    assert len(sp.sharding.device_set) == 8
+    # each shard holds a [nc/8, wc] row block
+    shard_shapes = {s.data.shape for s in sp.addressable_shards}
+    assert shard_shapes == {(eng.nc // 8, eng.wc)}
+
+
+def test_sharded_packed_classifier(mesh8):
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.classifier import ELClassifier
+
+    cfg = ClassifierConfig(
+        engine="packed", mesh_devices=8, use_native_loader=False
+    )
+    res = ELClassifier(cfg).classify_text(BOTTOM_ONTO)
+    assert "CatDog" in res.taxonomy.unsatisfiable
