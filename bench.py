@@ -25,7 +25,7 @@ from distel_tpu.owl import parser  # noqa: E402
 from distel_tpu.frontend.normalizer import normalize  # noqa: E402
 from distel_tpu.frontend.ontology_tools import synthetic_ontology  # noqa: E402
 from distel_tpu.core.indexing import index_ontology  # noqa: E402
-from distel_tpu.core.engine import SaturationEngine  # noqa: E402
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine  # noqa: E402
 from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
 
 
@@ -42,7 +42,7 @@ def main() -> None:
     norm = normalize(parser.parse(text))
     idx = index_ontology(norm)
 
-    engine = SaturationEngine(idx)
+    engine = RowPackedSaturationEngine(idx)
     # cold run = compile + execute; warm run is the steady-state number
     t0 = time.time()
     result = engine.saturate()

@@ -37,8 +37,9 @@ class ClassifierConfig:
     #: concept-axis padding granularity (MXU tiling + shard divisibility)
     pad_multiple: int = 128
     #: matmul compute dtype for the AND-OR semiring
-    #: ("auto"|"bfloat16"|"float32") — auto picks bf16 on TPU (MXU rate),
-    #: f32 elsewhere (CPU cannot execute a raw bf16 dot)
+    #: ("auto"|"int8"|"bfloat16"|"float32") — auto picks int8 for the
+    #: rowpacked engine (2x bf16 on the MXU, exact in i32 accumulation),
+    #: bf16 on TPU / f32 on CPU for the dense engine
     matmul_dtype: str = "auto"
     max_iterations: int = 10_000
     #: per-phase wall-clock tracing (reference instrumentation.enabled)
@@ -53,13 +54,12 @@ class ClassifierConfig:
     #: use the C++ load plane (native/distel_loader.cpp) when available —
     #: ~13x faster text→tensors than the Python frontend
     use_native_loader: bool = True
-    #: state representation: "dense" (bool arrays, mesh-shardable),
-    #: "packed" (uint32 bitsets + Pallas kernels, ~8x the single-chip
-    #: concept ceiling), or "auto" (packed beyond auto_packed_threshold
-    #: concepts on a single device)
+    #: state representation: "rowpacked" (transposed uint32 bitsets,
+    #: scatter-free — the flagship: fastest measured and 8x the dense
+    #: concept ceiling), "dense" (bool arrays, the simplest reference
+    #: path), "packed" (x-major uint32 bitsets + Pallas kernels), or
+    #: "auto" (rowpacked)
     engine: str = "auto"
-    #: concept count above which "auto" switches to the packed engine
-    auto_packed_threshold: int = 16384
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -96,8 +96,6 @@ class ClassifierConfig:
             cfg.use_native_loader = raw["native.loader"].lower() == "true"
         if "engine" in raw:
             cfg.engine = raw["engine"]
-        if "auto.packed.threshold" in raw:
-            cfg.auto_packed_threshold = int(raw["auto.packed.threshold"])
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v
@@ -108,6 +106,9 @@ class ClassifierConfig:
         backend at construction time."""
         import jax.numpy as jnp
 
-        return {"auto": None, "bfloat16": jnp.bfloat16, "float32": jnp.float32}[
-            self.matmul_dtype
-        ]
+        return {
+            "auto": None,
+            "int8": jnp.int8,
+            "bfloat16": jnp.bfloat16,
+            "float32": jnp.float32,
+        }[self.matmul_dtype]

@@ -96,29 +96,48 @@ def _unpack_bits_host(p: np.ndarray, m: int) -> np.ndarray:
 
 @dataclass
 class SaturationResult:
-    """Result of a saturation run.  ``s``/``r`` unpack lazily from the
-    bit-packed device transfer — consumers that only need counts (bench,
-    summary stats) never pay the unpacking cost."""
+    """Result of a saturation run.  ``packed_s``/``packed_r`` may still be
+    **device-resident** jax arrays: ``saturate`` fetches only scalars and
+    per-row counts, so a run whose consumer never reads the closure pays no
+    bulk D2H transfer (on remote-attached chips the tunnel runs ~10 MB/s —
+    two orders of magnitude below the device's compute on the same data).
+    ``s``/``r`` transfer + unpack lazily on first access and cache.
 
-    packed_s: np.ndarray  # [Nc, Nc/32] uint32
-    packed_r: np.ndarray  # [Nc, L/32] uint32
+    ``transposed=True`` marks row-packed-engine results, whose packed
+    arrays are subsumer-major ([a, xw] / [l, xw]); ``s``/``r`` still
+    always present the x-major [x, a] / [x, l] view."""
+
+    packed_s: np.ndarray  # [Nc, Nc/32] uint32 (np or device jax.Array)
+    packed_r: np.ndarray  # [Nc, L/32] uint32 (np or device jax.Array)
     iterations: int
     derivations: int
     idx: IndexedOntology
     converged: bool = True
+    transposed: bool = False
     _s: Optional[np.ndarray] = field(default=None, repr=False)
     _r: Optional[np.ndarray] = field(default=None, repr=False)
+
+    def _fetch(self) -> None:
+        """One-time D2H transfer of the packed closure (no-op if host-side)."""
+        if not isinstance(self.packed_s, np.ndarray):
+            self.packed_s, self.packed_r = jax.device_get(
+                (self.packed_s, self.packed_r)
+            )
 
     @property
     def s(self) -> np.ndarray:
         if self._s is None:
-            self._s = _unpack_bits_host(self.packed_s, self.packed_s.shape[0])
+            self._fetch()
+            u = _unpack_bits_host(self.packed_s, self.packed_s.shape[1] * 32)
+            self._s = u.T if self.transposed else u
         return self._s
 
     @property
     def r(self) -> np.ndarray:
         if self._r is None:
-            self._r = _unpack_bits_host(self.packed_r, self.packed_r.shape[1] * 32)
+            self._fetch()
+            u = _unpack_bits_host(self.packed_r, self.packed_r.shape[1] * 32)
+            self._r = u.T if self.transposed else u
         return self._r
 
     def subsumers(self, concept_id: int) -> Set[int]:
@@ -141,6 +160,37 @@ def _host_bit_total(bits: np.ndarray) -> int:
     """Sum per-row popcounts in int64 on the host (a device-side grand total
     would overflow i32 past ~46k concepts; x64 is disabled by default)."""
     return int(np.asarray(bits, np.int64).sum())
+
+
+def finish_device_run(
+    out,
+    idx: IndexedOntology,
+    budget: int,
+    allow_incomplete: bool,
+    transposed: bool,
+) -> "SaturationResult":
+    """Shared epilogue of the packed engines' ``saturate``: ``out`` is
+    ``(sp, rp, iteration, changed, bits, init_bits)`` where the scalars
+    may carry one lane per shard.  Fetches only scalars and per-row
+    counts — the packed closure stays device-resident until someone reads
+    it (``SaturationResult._fetch``)."""
+    sp, rp = out[0], out[1]
+    it, changed, bits, init_bits = jax.device_get(out[2:])
+    it, changed = np.max(it), np.max(changed)
+    converged = not bool(changed)
+    if not converged and not allow_incomplete:
+        raise RuntimeError(
+            f"saturation did not converge within {budget} iterations"
+        )
+    return SaturationResult(
+        packed_s=sp,
+        packed_r=rp,
+        iterations=int(it),
+        derivations=_host_bit_total(bits) - _host_bit_total(init_bits),
+        idx=idx,
+        converged=converged,
+        transposed=transposed,
+    )
 
 
 class SaturationEngine:
@@ -439,9 +489,7 @@ class SaturationEngine:
             if not changed:
                 converged = True
                 break
-        packed_s, packed_r = jax.device_get(
-            (self._pack_jit(s), self._pack_jit(r))
-        )
+        packed_s, packed_r = self._pack_jit(s), self._pack_jit(r)
         return self._finish(
             packed_s, packed_r, iteration, total - init_total,
             converged, allow_incomplete, budget,
@@ -471,12 +519,16 @@ class SaturationEngine:
             out, init_bits = self._run_from_jit(
                 self.embed_state(*initial), budget
             )
-        # exactly one host sync for the whole run
-        out, init_bits = jax.device_get((out, init_bits))
-        derivations = _host_bit_total(out.bits) - _host_bit_total(init_bits)
+        # exactly one host sync for the whole run — scalars and per-row
+        # counts only; the packed closure stays on device until someone
+        # actually reads it (SaturationResult._fetch)
+        iteration, changed, bits, init_bits = jax.device_get(
+            (out.iteration, out.changed, out.bits, init_bits)
+        )
+        derivations = _host_bit_total(bits) - _host_bit_total(init_bits)
         return self._finish(
-            out.packed_s, out.packed_r, int(out.iteration), derivations,
-            not bool(out.changed), allow_incomplete, budget,
+            out.packed_s, out.packed_r, int(iteration), derivations,
+            not bool(changed), allow_incomplete, budget,
         )
 
     def _finish(

@@ -48,8 +48,8 @@ from jax import lax
 
 from distel_tpu.core.engine import (
     SaturationResult,
-    _host_bit_total,
     _pad_up,
+    finish_device_run,
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.ops.bitmatmul import PackedMatmulPlan
@@ -384,20 +384,8 @@ class PackedSaturationEngine:
             out = self._run_jit(sp0, rp0, budget)
         else:
             out = self._run_jit(budget)(sp0, rp0)
-        sp, rp, it, changed, bits, init_bits = jax.device_get(out)
-        it, changed = np.max(it), np.max(changed)
-        converged = not bool(changed)
-        if not converged and not allow_incomplete:
-            raise RuntimeError(
-                f"saturation did not converge within {budget} iterations"
-            )
-        return SaturationResult(
-            packed_s=sp,
-            packed_r=rp,
-            iterations=int(it),
-            derivations=_host_bit_total(bits) - _host_bit_total(init_bits),
-            idx=self.idx,
-            converged=converged,
+        return finish_device_run(
+            out, self.idx, budget, allow_incomplete, transposed=False
         )
 
     def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:

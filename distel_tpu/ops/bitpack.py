@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def pack_bool_columns(x) -> jnp.ndarray:
@@ -25,11 +26,11 @@ def pack_bool_columns(x) -> jnp.ndarray:
     return jnp.sum(w * weights, axis=-1, dtype=jnp.uint32)
 
 
-def unpack_words(p, m: int) -> jnp.ndarray:
-    """uint32 [N, W] → bool [N, m] (m <= 32*W), standard layout."""
+def unpack_words(p, m: int, dtype=bool) -> jnp.ndarray:
+    """uint32 [N, W] → ``dtype`` [N, m] (m <= 32*W), standard layout."""
     shifts = jnp.arange(32, dtype=jnp.uint32)
     bits = (p[:, :, None] >> shifts) & jnp.asarray(1, jnp.uint32)
-    return bits.reshape(p.shape[0], -1)[:, :m].astype(bool)
+    return bits.reshape(p.shape[0], -1)[:, :m].astype(dtype)
 
 
 def gather_bit_columns(p, cols: np.ndarray) -> jnp.ndarray:
@@ -105,3 +106,59 @@ def scatter_or_columns(packed, source_bits, targets: np.ndarray) -> jnp.ndarray:
     return ColumnScatter(np.asarray(targets), packed.shape[1]).apply(
         packed, source_bits
     )
+
+
+class SegmentedRowOr:
+    """Static plan for OR-combining packed *rows* that share a target row.
+
+    XLA's scatter op on TPU serializes per index and runs two orders of
+    magnitude below HBM speed for thousands of targets (measured ~1.3 µs
+    per scattered column at 20k concepts), so the row-packed engine never
+    scatter-MAXes.  Instead: sort the sources by target once at build time,
+    OR each run of same-target rows with one segmented ``associative_scan``
+    at runtime, and write the per-target results with a scatter-*set* over
+    the (unique) target rows — which XLA lowers to a fast dense update.
+
+    ``order`` re-sorts the caller's per-axiom rows; ``targets`` are the
+    distinct target row ids, aligned with :meth:`reduce`'s output.
+    """
+
+    def __init__(self, raw_targets: np.ndarray):
+        raw_targets = np.asarray(raw_targets, np.int64)
+        self.k = len(raw_targets)
+        self.order = np.argsort(raw_targets, kind="stable")
+        sorted_t = raw_targets[self.order]
+        self.targets, first = np.unique(sorted_t, return_index=True)
+        starts = np.zeros(self.k, bool)
+        starts[first] = True
+        self._starts = starts
+        self._last = np.r_[first[1:] - 1, self.k - 1] if self.k else first
+
+    @property
+    def n_targets(self) -> int:
+        return len(self.targets)
+
+    def reduce(self, rows) -> jnp.ndarray:
+        """OR-reduce ``rows`` [K, W] (any integer dtype, already in
+        ``order``) within each same-target run → [n_targets, W]."""
+        if self.k == 1:
+            return rows
+        starts = jnp.asarray(self._starts)
+
+        def comb(x, y):
+            xs, xv = x
+            ys, yv = y
+            return ys | xs, jnp.where(ys[:, None], yv, yv | xv)
+
+        _, v = lax.associative_scan(comb, (starts, rows), axis=0)
+        return v[jnp.asarray(self._last)]
+
+    def apply(self, state, rows) -> jnp.ndarray:
+        """OR ``rows`` [K, W] (in ``order``) into ``state`` [N, W] at this
+        plan's target rows."""
+        if self.k == 0:
+            return state
+        state = jnp.asarray(state)
+        t = jnp.asarray(self.targets)
+        merged = state[t] | self.reduce(rows)
+        return state.at[t].set(merged)

@@ -77,20 +77,21 @@ class ELClassifier:
             self._mesh = jax.sharding.Mesh(np.array(devs[:n]), ("c",))
 
     def _make_engine(self, idx: IndexedOntology):
-        """Engine selection: the packed bitset engine (single-chip or
-        row-sharded over the mesh) lifts the concept ceiling ~8x; the dense
-        engine remains the simplest-possible reference path."""
+        """Engine selection: the row-packed transposed engine is the
+        flagship (fastest measured on TPU and 8x the dense concept
+        ceiling); "dense" and "packed" remain the reference paths."""
         cfg = self.config
-        choice = cfg.engine
-        if choice == "auto":
-            choice = (
-                "packed"
-                if idx.n_concepts > cfg.auto_packed_threshold
-                else "dense"
+        choice = "rowpacked" if cfg.engine == "auto" else cfg.engine
+        if choice == "rowpacked":
+            from distel_tpu.core.rowpacked_engine import (
+                RowPackedSaturationEngine,
             )
-        if choice not in ("packed", "dense"):
-            raise ValueError(
-                f"unknown engine {cfg.engine!r}: expected 'auto', 'packed' or 'dense'"
+
+            return RowPackedSaturationEngine(
+                idx,
+                pad_multiple=cfg.pad_multiple,
+                mesh=self._mesh,
+                matmul_dtype=cfg.matmul_jnp_dtype(),
             )
         if choice == "packed":
             from distel_tpu.core.packed_engine import PackedSaturationEngine
@@ -100,6 +101,11 @@ class ELClassifier:
                 pad_multiple=cfg.pad_multiple,
                 mesh=self._mesh,
                 matmul_dtype=cfg.matmul_jnp_dtype(),
+            )
+        if choice != "dense":
+            raise ValueError(
+                f"unknown engine {cfg.engine!r}: expected 'auto', "
+                "'rowpacked', 'packed' or 'dense'"
             )
         return SaturationEngine(
             idx,

@@ -124,7 +124,7 @@ def test_classifier_engine_selection():
     cfg = ClassifierConfig(engine="packed", use_native_loader=False)
     res = ELClassifier(cfg).classify_text(BOTTOM_ONTO)
     assert "CatDog" in res.taxonomy.unsatisfiable
-    cfg2 = ClassifierConfig(engine="auto", auto_packed_threshold=1)
+    cfg2 = ClassifierConfig(engine="auto")  # auto = rowpacked flagship
     res2 = ELClassifier(cfg2).classify_text(BOTTOM_ONTO)
     assert res2.result.derivations == res.result.derivations
 
