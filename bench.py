@@ -32,7 +32,7 @@ from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
 def main() -> None:
     import jax
 
-    n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 4000
+    n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 8000
     text = synthetic_ontology(
         n_classes=n_classes,
         n_anatomy=max(200, n_classes // 10),

@@ -73,8 +73,9 @@ from distel_tpu.core.engine import (
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.ops.bitpack import (
     SegmentedRowOr,
-    pack_bool_columns,
-    unpack_words,
+    bit_lookup,
+    pack_planes,
+    unpack_words_planes,
 )
 
 
@@ -93,6 +94,7 @@ class RowPackedSaturationEngine:
         unroll: int = 4,
         mesh: Optional[jax.sharding.Mesh] = None,
         word_axis: str = "c",
+        temp_budget_bytes: int = 1 << 29,
     ):
         self.idx = idx
         self.unroll = max(int(unroll), 1)
@@ -125,8 +127,12 @@ class RowPackedSaturationEngine:
             fillers[: idx.n_links] = idx.links[:, 1]
         self._fillers = fillers
 
-        # CR4: rows of the [K4, L] operand in seg-OR target order
+        # CR4: rows of the [K4, L] operand in seg-OR target order.  The
+        # closure masks are device arrays passed as *arguments* to the
+        # jitted run — embedded as program constants they get serialized
+        # into every (remote) compile request, which breaks past ~100 MB.
         self._p4 = None
+        m4 = np.zeros((0, 0), np.int8)
         if len(idx.nf4) and idx.n_links:
             self._p4 = SegmentedRowOr(idx.nf4[:, 2])
             nf4o = idx.nf4[self._p4.order]
@@ -135,10 +141,10 @@ class RowPackedSaturationEngine:
             # (transitive) subrole of the axiom's s
             m4 = np.zeros((len(nf4o), self.nl), np.int8)
             m4[:, : idx.n_links] = h.T[nf4o[:, 0]][:, link_roles].astype(np.int8)
-            self._m4 = m4
 
         # CR6: chain second legs, same layout
         self._p6 = None
+        m6 = np.zeros((0, 0), np.int8)
         if len(idx.chain_pairs) and idx.n_links:
             self._p6 = SegmentedRowOr(idx.chain_pairs[:, 2])
             cpo = idx.chain_pairs[self._p6.order]
@@ -146,9 +152,21 @@ class RowPackedSaturationEngine:
             # m6[p, l] = H[role(l), r_p] — first-leg subrole closure
             m6 = np.zeros((len(cpo), self.nl), np.int8)
             m6[:, : idx.n_links] = h.T[cpo[:, 0]][:, link_roles].astype(np.int8)
-            self._m6 = m6
+        self._masks = (jnp.asarray(m4), jnp.asarray(m6))
 
         self._bottom = bool(idx.has_bottom_axioms and idx.n_links)
+
+        # Bound per-rule temporaries by splitting each rule into chunks at
+        # segment boundaries: a fused application materializes O(K·wc)
+        # gather/scan buffers (CR1-CR3) or an O(K·nc) i32 matmul output
+        # (CR4/CR6) — unchunked, either exceeds HBM near 100k concepts.
+        gather_rows = max(temp_budget_bytes // (self.wc * 4), 1)
+        mm_rows = max(temp_budget_bytes // 2 // (self.nc * 4), 1)
+        self._cr1_chunks = self._p1.split(gather_rows)
+        self._cr2_chunks = self._p2.split(gather_rows // 2)
+        self._cr3_chunks = self._p3.split(gather_rows)
+        self._cr4_chunks = self._p4.split(mm_rows) if self._p4 else []
+        self._cr6_chunks = self._p6.split(mm_rows) if self._p6 else []
 
         # live-column word mask: bits for x < n_concepts only
         wmask = np.zeros(self.wc, np.uint32)
@@ -168,7 +186,7 @@ class RowPackedSaturationEngine:
         self._step_jit = jax.jit(self._step)
         self._initial_jit = None
         if mesh is None:
-            self._run_jit = jax.jit(self._run, static_argnums=(2,))
+            self._run_jit = jax.jit(self._run, static_argnums=(3,))
         else:
             self._run_jit = functools.lru_cache(maxsize=4)(self._sharded_run)
 
@@ -238,82 +256,78 @@ class RowPackedSaturationEngine:
 
     # ------------------------------------------------------------- rules
 
-    def _filler_onehot(self, n_local: int, axis_name: Optional[str]):
-        """E[x, j] = 1 iff local column x is filler(j) — the selection
-        operand that turns bit lookups into MXU matmuls.  Computed from an
-        iota each step (never stored: at SNOMED scale it would not fit)."""
-        base = (
-            0
-            if axis_name is None
-            else lax.axis_index(axis_name) * (32 * (self.wc // self.n_shards))
-        )
-        xs = jnp.arange(n_local) + base
-        return (xs[:, None] == jnp.asarray(self._fillers)[None, :]).astype(
-            self.matmul_dtype
-        )
-
     def _bit_table(
-        self, up_rows: jax.Array, eh: jax.Array, axis_name: Optional[str]
+        self, p: jax.Array, rows: np.ndarray, axis_name: Optional[str]
     ) -> jax.Array:
-        """``out[i, j] = bit(row i, column fillers[j])`` as int8
-        [rows, nl], from already-unpacked rows ``up_rows`` [rows, nc_loc].
-
-        A direct 2D bit gather runs ~8 ns *per element* on TPU (XLA
-        lowers it elementwise — same pathology as scatter), so the lookup
-        is instead one [rows, nc] @ [nc, nl] one-hot matmul on the MXU.
-        Under sharding each filler column lives on exactly one shard, so
-        the partial-product psum IS the exchange — the only cross-shard
-        data of the whole step (the packed analog of the reference's
-        delta reads against the result node,
-        ``base/Type2AxiomProcessorBase.java:101-116``)."""
-        out = jnp.matmul(up_rows, eh, preferred_element_type=jnp.int32)
-        if axis_name is not None:
-            out = lax.psum(out, axis_name)
-        return (out > 0).astype(self.matmul_dtype)
+        """``out[j, i] = bit(p[rows[i], column fillers[j]])`` as the
+        matmul dtype, [nl, len(rows)] (transposed — callers fold the
+        transpose into their next op).  Linear-cost lookup via
+        ``ops.bitpack.bit_lookup``; under sharding each filler column
+        lives on exactly one shard, so a masked local lookup + psum IS
+        the exchange — the only cross-shard data of the whole step (the
+        packed analog of the reference's delta reads against the result
+        node, ``base/Type2AxiomProcessorBase.java:101-116``)."""
+        dt = self.matmul_dtype
+        cols = self._fillers
+        if axis_name is None:
+            return bit_lookup(p, rows, cols, dtype=dt)
+        base = lax.axis_index(axis_name) * (self.wc // self.n_shards)
+        bits = bit_lookup(p, rows, cols, word_offset=base, dtype=jnp.int32)
+        return lax.psum(bits, axis_name).astype(dt)
 
     def _step(
         self,
         sp: jax.Array,
         rp: jax.Array,
+        masks: Optional[Tuple[jax.Array, jax.Array]] = None,
         axis_name: Optional[str] = None,
     ) -> Tuple[jax.Array, jax.Array]:
+        m4, m6 = self._masks if masks is None else masks
         dt = self.matmul_dtype
         # CR1: a ⊑ b
-        if self._p1.k:
-            sp = self._p1.apply(sp, sp[self._src1])
+        for sl, plan in self._cr1_chunks:
+            sp = plan.apply(sp, sp[self._src1[sl]])
         # CR2: a1 ⊓ a2 ⊑ b
-        if self._p2.k:
-            sp = self._p2.apply(sp, sp[self._src2a] & sp[self._src2b])
+        for sl, plan in self._cr2_chunks:
+            sp = plan.apply(sp, sp[self._src2a[sl]] & sp[self._src2b[sl]])
         # CR3: a ⊑ ∃link
-        if self._p3.k:
-            rp = self._p3.apply(rp, sp[self._src3])
-        if self._p4 is not None or self._p6 is not None or self._bottom:
-            # unpack R_T's (local) columns once for all MXU contractions,
-            # and build the shared filler-selection one-hot
-            runp = unpack_words(rp, rp.shape[1] * 32, dt)
-            eh = self._filler_onehot(rp.shape[1] * 32, axis_name)
+        for sl, plan in self._cr3_chunks:
+            rp = plan.apply(rp, sp[self._src3[sl]])
+        if self._p4 is not None or self._p6 is not None:
+            # unpack R_T's (local) columns once for both MXU contractions —
+            # bit-plane-major, so no 8-byte-per-bit intermediate exists
+            # and the matmul outputs repack with pack_planes.  This is the
+            # one temporary temp_budget_bytes does NOT bound (nl*nc_local
+            # int8); on a single chip it caps out around nl*nc ≈ HBM/4,
+            # and the sharded path bounds it naturally (each shard unpacks
+            # only its word slice).  Removing it entirely needs a Pallas
+            # matmul kernel with packed output columns.
+            runp = unpack_words_planes(rp, dt)
         # CR4: ∃s.a ⊑ b
         if self._p4 is not None:
-            up4 = unpack_words(sp[jnp.asarray(self._a4)], rp.shape[1] * 32, dt)
-            f4 = self._bit_table(up4, eh, axis_name)
-            w = jnp.asarray(self._m4) * f4
-            out = (
-                jnp.matmul(w, runp, preferred_element_type=jnp.int32) > 0
-            )
-            sp = self._p4.apply(sp, pack_bool_columns(out))
-        # CR6: role chains — second-leg rows reuse the unpacked R_T
+            for sl, plan in self._cr4_chunks:
+                f4 = self._bit_table(sp, self._a4[sl], axis_name)  # [nl, ck]
+                w = m4[sl] * f4.T
+                out = (
+                    jnp.matmul(w, runp, preferred_element_type=jnp.int32)
+                    > 0
+                )
+                sp = plan.apply(sp, pack_planes(out))
+        # CR6: role chains
         if self._p6 is not None:
-            f6 = self._bit_table(runp[jnp.asarray(self._l26)], eh, axis_name)
-            d = jnp.asarray(self._m6) * f6
-            out = (
-                jnp.matmul(d, runp, preferred_element_type=jnp.int32) > 0
-            )
-            rp = self._p6.apply(rp, pack_bool_columns(out))
+            for sl, plan in self._cr6_chunks:
+                f6 = self._bit_table(rp, self._l26[sl], axis_name)  # [nl, ck]
+                d = m6[sl] * f6.T
+                out = (
+                    jnp.matmul(d, runp, preferred_element_type=jnp.int32)
+                    > 0
+                )
+                rp = plan.apply(rp, pack_planes(out))
         # CR5: ⊥ back-propagation — one masked packed OR-reduce
         if self._bottom:
-            upb = unpack_words(sp[BOTTOM_ID][None, :], rp.shape[1] * 32, dt)
-            botf = self._bit_table(upb, eh, axis_name)[0].astype(bool)
-            masked = jnp.where(botf[:, None], rp, jnp.asarray(0, jnp.uint32))
+            botf = self._bit_table(sp, np.full(1, BOTTOM_ID), axis_name)
+            mask = botf[:, 0].astype(bool)                  # [nl]
+            masked = jnp.where(mask[:, None], rp, jnp.asarray(0, jnp.uint32))
             newrow = lax.reduce(
                 masked, np.uint32(0), lax.bitwise_or, (0,)
             )
@@ -321,7 +335,7 @@ class RowPackedSaturationEngine:
         return sp, rp
 
     def step(self, sp, rp):
-        return self._step_jit(sp, rp)
+        return self._step_jit(sp, rp, self._masks)
 
     # -------------------------------------------------------- fixed point
 
@@ -345,7 +359,8 @@ class RowPackedSaturationEngine:
         return jnp.concatenate([bs, br])
 
     def _run(
-        self, sp0, rp0, max_iters: int, axis_name: Optional[str] = None
+        self, sp0, rp0, masks, max_iters: int,
+        axis_name: Optional[str] = None,
     ):
         unroll = self.unroll
 
@@ -357,7 +372,7 @@ class RowPackedSaturationEngine:
             sp, rp, it, _ = st
             sp2, rp2 = sp, rp
             for _ in range(unroll):
-                sp2, rp2 = self._step(sp2, rp2, axis_name)
+                sp2, rp2 = self._step(sp2, rp2, masks, axis_name)
             changed = jnp.any(sp2 != sp) | jnp.any(rp2 != rp)
             if axis_name is not None:
                 # the reference's global AND-vote
@@ -377,9 +392,9 @@ class RowPackedSaturationEngine:
         P = jax.sharding.PartitionSpec
         axis = self.word_axis
 
-        def run(sp0, rp0):
+        def run(sp0, rp0, masks):
             sp, rp, it, changed, bits, init_bits = self._run(
-                sp0, rp0, max_iters, axis
+                sp0, rp0, masks, max_iters, axis
             )
             # scalars leave as one lane per shard (replicated by
             # construction); bits leave as per-shard partial sums
@@ -389,7 +404,11 @@ class RowPackedSaturationEngine:
             jax.shard_map(
                 run,
                 mesh=self.mesh,
-                in_specs=(P(None, axis), P(None, axis)),
+                in_specs=(
+                    P(None, axis),
+                    P(None, axis),
+                    (P(None, None), P(None, None)),
+                ),
                 out_specs=(
                     P(None, axis),
                     P(None, axis),
@@ -415,9 +434,9 @@ class RowPackedSaturationEngine:
         else:
             sp0, rp0 = self.embed_state(*initial)
         if self.mesh is None:
-            out = self._run_jit(sp0, rp0, budget)
+            out = self._run_jit(sp0, rp0, self._masks, budget)
         else:
-            out = self._run_jit(budget)(sp0, rp0)
+            out = self._run_jit(budget)(sp0, rp0, self._masks)
         return finish_device_run(
             out, self.idx, budget, allow_incomplete, transposed=True
         )

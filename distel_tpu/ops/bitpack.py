@@ -33,6 +33,68 @@ def unpack_words(p, m: int, dtype=bool) -> jnp.ndarray:
     return bits.reshape(p.shape[0], -1)[:, :m].astype(dtype)
 
 
+def unpack_words_planes(p, dtype=jnp.int8) -> jnp.ndarray:
+    """uint32 [N, W] → ``dtype`` [N, 32*W] in **bit-plane-major** order:
+    output position ``pl*W + w`` holds bit ``pl`` of word ``w`` (logical
+    column ``32*w + pl``).  Unlike :func:`unpack_words`, never builds the
+    [N, W, 32] uint32 intermediate (8 bytes/bit — the allocation that
+    OOMs at ~100k concepts); each plane narrows to ``dtype`` immediately."""
+    one = jnp.asarray(1, jnp.uint32)
+    planes = [
+        ((p >> jnp.asarray(pl, jnp.uint32)) & one).astype(dtype)
+        for pl in range(32)
+    ]
+    return jnp.concatenate(planes, axis=1)
+
+
+def pack_planes(bits) -> jnp.ndarray:
+    """Inverse companion of :func:`unpack_words_planes`: bool/int [N, 32*W]
+    in bit-plane-major order → uint32 [N, W]."""
+    n, m = bits.shape
+    w = m // 32
+    b3 = bits.reshape(n, 32, w).astype(jnp.uint32)
+    weights = (
+        jnp.asarray(1, jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, :, None]
+    )
+    return jnp.sum(b3 * weights, axis=1, dtype=jnp.uint32)
+
+
+def bit_lookup(
+    p,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    *,
+    word_offset=None,
+    dtype=bool,
+) -> jnp.ndarray:
+    """``out[j, i] = bit(p[rows[i], cols[j]])`` — TRANSPOSED output
+    [len(cols), len(rows)] in ``dtype``.
+
+    A direct 2D bit gather (``p[rows[:,None], cols>>5]``) lowers
+    elementwise on TPU (~8 ns/element); a one-hot selection matmul is
+    O(len(rows)·N·len(cols)) MACs — cubic at ontology scale.  This
+    version is linear: contiguous row gather → transpose → contiguous
+    row gather on the word axis → per-row shift.
+
+    ``word_offset`` (traced scalar) supports sharded callers whose ``p``
+    holds only the word window ``[word_offset, word_offset + W)``:
+    out-of-window columns yield 0 (the caller psums the partials)."""
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    if rows.size == 0 or cols.size == 0:
+        return jnp.zeros((cols.size, rows.size), dtype)
+    subt = p[jnp.asarray(rows)].T             # [W, R] (one transpose copy)
+    w = jnp.asarray(cols >> 5)
+    if word_offset is not None:
+        w = w - word_offset
+    ok = (w >= 0) & (w < subt.shape[0])
+    words = subt[jnp.clip(w, 0, subt.shape[0] - 1)]    # [C, R] row gather
+    shifts = jnp.asarray((cols & 31).astype(np.uint32))[:, None]
+    bits = (words >> shifts) & jnp.asarray(1, jnp.uint32)
+    return jnp.where(ok[:, None], bits, 0).astype(dtype)
+
+
 def gather_bit_columns(p, cols: np.ndarray) -> jnp.ndarray:
     """Extract logical columns ``cols`` from packed ``p`` [N, W] →
     bool [N, len(cols)].  ``cols`` is a static numpy index vector, so the
@@ -162,3 +224,35 @@ class SegmentedRowOr:
         t = jnp.asarray(self.targets)
         merged = state[t] | self.reduce(rows)
         return state.at[t].set(merged)
+
+    def split(self, max_rows: int):
+        """Partition into subplans of at most ``max_rows`` source rows
+        each (never splitting a same-target run, so each target row is
+        written by exactly one subplan).  Returns ``[(slice, subplan)]``
+        where ``slice`` indexes the caller's ``order``-permuted source
+        arrays.  Used to bound per-rule temporaries: a single fused rule
+        application materializes O(K·W) gather + scan buffers, which
+        exceeds HBM at ~100k-concept scale."""
+        if self.k == 0:
+            return []
+        max_rows = max(int(max_rows), 1)
+        starts = np.nonzero(self._starts)[0]
+        sorted_targets = np.repeat(
+            self.targets, np.diff(np.r_[starts, self.k])
+        )
+        pieces = []
+        cur = 0
+        while cur < self.k:
+            if self.k - cur <= max_rows:
+                cut = self.k
+            else:
+                later = starts[(starts > cur) & (starts <= cur + max_rows)]
+                # a single run longer than max_rows becomes its own piece
+                cut = int(later[-1]) if later.size else int(
+                    starts[starts > cur][0]
+                ) if (starts > cur).any() else self.k
+            pieces.append(
+                (slice(cur, cut), SegmentedRowOr(sorted_targets[cur:cut]))
+            )
+            cur = cut
+        return pieces

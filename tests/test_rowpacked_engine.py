@@ -146,6 +146,18 @@ def test_rowpacked_role_hierarchy_direction():
     assert idx.concept_ids["ParentOfDog"] not in rowp.subsumers(dog)
 
 
+def test_rowpacked_chunked_rules_match_fused(small):
+    # a tiny temp budget forces every rule through the multi-chunk path
+    norm, idx = small
+    fused = RowPackedSaturationEngine(idx).saturate()
+    chunked_eng = RowPackedSaturationEngine(idx, temp_budget_bytes=64)
+    assert len(chunked_eng._cr1_chunks) > 1
+    chunked = chunked_eng.saturate()
+    assert chunked.derivations == fused.derivations
+    assert (chunked.s == fused.s).all()
+    assert (chunked.r == fused.r).all()
+
+
 def test_classifier_rowpacked_engine():
     from distel_tpu.config import ClassifierConfig
     from distel_tpu.runtime.classifier import ELClassifier
