@@ -162,6 +162,27 @@ def _host_bit_total(bits: np.ndarray) -> int:
     return int(np.asarray(bits, np.int64).sum())
 
 
+def observed_loop(
+    observe_step, s, r, init_total: int, unroll: int, budget: int, observer
+):
+    """Shared superstep/observer protocol of both engines'
+    ``saturate_observed``: run ``observe_step`` (returning
+    ``(s, r, changed, live_bits)``) until convergence or budget, calling
+    ``observer(iteration, derivations, changed)`` after each round."""
+    iteration, converged, total = 0, False, init_total
+    while iteration < budget:
+        s, r, changed_dev, bits = observe_step(s, r)
+        iteration += unroll
+        changed, bits_host = jax.device_get((changed_dev, bits))
+        total = _host_bit_total(bits_host)
+        if observer is not None:
+            observer(iteration, total - init_total, bool(changed))
+        if not changed:
+            converged = True
+            break
+    return s, r, iteration, total, converged
+
+
 def finish_device_run(
     out,
     idx: IndexedOntology,
@@ -477,18 +498,9 @@ class SaturationEngine:
             s, r = jnp.array(s, copy=True), jnp.array(r, copy=True)
         init_total = _host_bit_total(jax.device_get(self._live_bits(s, r)))
         budget = _pad_up(max_iters, self.unroll)
-        iteration, converged = 0, False
-        total = init_total
-        while iteration < budget:
-            s, r, changed_dev, bits = self._observe_jit(s, r)
-            iteration += self.unroll
-            changed, bits_host = jax.device_get((changed_dev, bits))
-            total = _host_bit_total(bits_host)
-            if observer is not None:
-                observer(iteration, total - init_total, bool(changed))
-            if not changed:
-                converged = True
-                break
+        s, r, iteration, total, converged = observed_loop(
+            self._observe_jit, s, r, init_total, self.unroll, budget, observer
+        )
         packed_s, packed_r = self._pack_jit(s), self._pack_jit(r)
         return self._finish(
             packed_s, packed_r, iteration, total - init_total,

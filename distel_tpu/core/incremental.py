@@ -21,7 +21,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from distel_tpu.config import ClassifierConfig
-from distel_tpu.core.engine import SaturationEngine, SaturationResult
+from distel_tpu.core.engine import SaturationResult
 from distel_tpu.core.indexing import Indexer
 from distel_tpu.frontend.normalizer import NormalizedOntology, Normalizer
 from distel_tpu.owl import loader as owl_loader
@@ -63,11 +63,9 @@ class IncrementalClassifier:
         _merge(self.accumulated, batch)
 
         idx = self.indexer.index(self.accumulated)
-        engine = SaturationEngine(
-            idx,
-            pad_multiple=self.config.pad_multiple,
-            matmul_dtype=self.config.matmul_jnp_dtype(),
-        )
+        from distel_tpu.runtime.classifier import make_engine
+
+        engine = make_engine(self.config, idx)
         result = engine.saturate(
             self.config.max_iterations,
             initial=self._state,

@@ -67,8 +67,10 @@ from jax import lax
 
 from distel_tpu.core.engine import (
     SaturationResult,
+    _host_bit_total,
     _pad_up,
     finish_device_run,
+    observed_loop,
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.ops.bitpack import (
@@ -185,6 +187,8 @@ class RowPackedSaturationEngine:
             self._state_sharding = None
         self._step_jit = jax.jit(self._step)
         self._initial_jit = None
+        self._observe_jit = None
+        self._live_bits_jit = None
         if mesh is None:
             self._run_jit = jax.jit(self._run, static_argnums=(3,))
         else:
@@ -419,6 +423,66 @@ class RowPackedSaturationEngine:
                 ),
                 check_vma=False,
             )
+        )
+
+    def _observe_round(self, sp, rp, masks):
+        sp2, rp2 = sp, rp
+        for _ in range(self.unroll):
+            sp2, rp2 = self._step(sp2, rp2, masks)
+        changed = jnp.any(sp2 != sp) | jnp.any(rp2 != rp)
+        return sp2, rp2, changed, self._live_bits(sp2, rp2)
+
+    def saturate_observed(
+        self,
+        max_iters: int = 10_000,
+        *,
+        observer=None,
+        initial: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        allow_incomplete: bool = False,
+    ) -> SaturationResult:
+        """Fixed point with per-superstep observation — the observable
+        analog of the reference's progress plane (pub-sub gossip consumed
+        by ``worksteal/ProgressMessageHandler.java`` and the timed
+        completeness snapshots of ``misc/ResultSnapshotter.java``).  One
+        host sync per superstep, so use :meth:`saturate` for benchmarks.
+        Single-device (on a mesh, run :meth:`saturate`)."""
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "observed mode is single-device; use saturate() on a mesh"
+            )
+        if self._observe_jit is None:
+            # old sp/rp are dead after each round — donate the buffers
+            self._observe_jit = jax.jit(
+                self._observe_round, donate_argnums=(0, 1)
+            )
+        if initial is None:
+            sp, rp = self.initial_state()
+        else:
+            # embed_state always allocates fresh arrays, so donation in
+            # _observe_jit cannot invalidate the caller's buffers
+            sp, rp = self.embed_state(*initial)
+        if self._live_bits_jit is None:
+            self._live_bits_jit = jax.jit(self._live_bits)
+        init_total = _host_bit_total(
+            jax.device_get(self._live_bits_jit(sp, rp))
+        )
+        budget = _pad_up(max_iters, self.unroll)
+        sp, rp, iteration, total, converged = observed_loop(
+            lambda s, r: self._observe_jit(s, r, self._masks),
+            sp, rp, init_total, self.unroll, budget, observer,
+        )
+        if not converged and not allow_incomplete:
+            raise RuntimeError(
+                f"saturation did not converge within {budget} iterations"
+            )
+        return SaturationResult(
+            packed_s=sp,
+            packed_r=rp,
+            iterations=iteration,
+            derivations=total - init_total,
+            idx=self.idx,
+            converged=converged,
+            transposed=True,
         )
 
     def saturate(

@@ -59,6 +59,32 @@ class ClassificationResult:
         }
 
 
+def make_engine(config: ClassifierConfig, idx: IndexedOntology, mesh=None):
+    """Engine selection: the row-packed transposed engine is the flagship
+    (fastest measured on TPU and 8x the dense concept ceiling); "dense"
+    and "packed" remain the reference paths."""
+    choice = "rowpacked" if config.engine == "auto" else config.engine
+    kw = dict(
+        pad_multiple=config.pad_multiple,
+        mesh=mesh,
+        matmul_dtype=config.matmul_jnp_dtype(),
+    )
+    if choice == "rowpacked":
+        from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+
+        return RowPackedSaturationEngine(idx, **kw)
+    if choice == "packed":
+        from distel_tpu.core.packed_engine import PackedSaturationEngine
+
+        return PackedSaturationEngine(idx, **kw)
+    if choice != "dense":
+        raise ValueError(
+            f"unknown engine {config.engine!r}: expected 'auto', "
+            "'rowpacked', 'packed' or 'dense'"
+        )
+    return SaturationEngine(idx, **kw)
+
+
 class ELClassifier:
     """One classifier instance per config — owns the mesh and jit caches."""
 
@@ -77,42 +103,7 @@ class ELClassifier:
             self._mesh = jax.sharding.Mesh(np.array(devs[:n]), ("c",))
 
     def _make_engine(self, idx: IndexedOntology):
-        """Engine selection: the row-packed transposed engine is the
-        flagship (fastest measured on TPU and 8x the dense concept
-        ceiling); "dense" and "packed" remain the reference paths."""
-        cfg = self.config
-        choice = "rowpacked" if cfg.engine == "auto" else cfg.engine
-        if choice == "rowpacked":
-            from distel_tpu.core.rowpacked_engine import (
-                RowPackedSaturationEngine,
-            )
-
-            return RowPackedSaturationEngine(
-                idx,
-                pad_multiple=cfg.pad_multiple,
-                mesh=self._mesh,
-                matmul_dtype=cfg.matmul_jnp_dtype(),
-            )
-        if choice == "packed":
-            from distel_tpu.core.packed_engine import PackedSaturationEngine
-
-            return PackedSaturationEngine(
-                idx,
-                pad_multiple=cfg.pad_multiple,
-                mesh=self._mesh,
-                matmul_dtype=cfg.matmul_jnp_dtype(),
-            )
-        if choice != "dense":
-            raise ValueError(
-                f"unknown engine {cfg.engine!r}: expected 'auto', "
-                "'rowpacked', 'packed' or 'dense'"
-            )
-        return SaturationEngine(
-            idx,
-            pad_multiple=cfg.pad_multiple,
-            mesh=self._mesh,
-            matmul_dtype=cfg.matmul_jnp_dtype(),
-        )
+        return make_engine(self.config, idx, mesh=self._mesh)
 
     # ------------------------------------------------------------------
 

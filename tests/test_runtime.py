@@ -54,6 +54,34 @@ def test_taxonomy_structure(classified):
     assert "Dog" in tax.subsumers["CatDog"]
 
 
+def test_taxonomy_device_matches_host():
+    # device path (bit-lookup projection + MXU reduction + lazy subsumer
+    # reconstruction) must agree exactly with the numpy host path, across
+    # engines/layouts, incl. equivalences and an unsat class
+    from distel_tpu.core.engine import SaturationEngine
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import synthetic_ontology
+    from distel_tpu.owl import parser
+
+    for corpus in (
+        ONTO,
+        synthetic_ontology(
+            n_classes=250, n_anatomy=40, n_locations=30, n_definitions=25
+        ),
+    ):
+        idx = index_ontology(normalize(parser.parse(corpus)))
+        for engine in (RowPackedSaturationEngine(idx), SaturationEngine(idx)):
+            result = engine.saturate()
+            dev = extract_taxonomy(result, method="device")
+            host = extract_taxonomy(result, method="host")
+            assert dev.unsatisfiable == host.unsatisfiable
+            assert dev.parents == host.parents
+            assert dev.equivalents == host.equivalents
+            assert dev.subsumers == host.subsumers
+
+
 def test_taxonomy_write_roundtrip(classified, tmp_path):
     p = tmp_path / "taxonomy.ofn"
     classified.taxonomy.write(str(p))
@@ -224,6 +252,26 @@ def test_cli_multiply(onto_file, tmp_path):
 
 
 # ---------------------------------------------------------------- progress
+
+
+def test_rowpacked_saturate_observed_matches_saturate():
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+    from distel_tpu.runtime.progress import ProgressReporter
+
+    idx = index_ontology(normalize(parser.parse(ONTO)))
+    engine = RowPackedSaturationEngine(idx)
+    plain = engine.saturate()
+    reporter = ProgressReporter().start()
+    observed = engine.saturate_observed(observer=reporter)
+    assert observed.derivations == plain.derivations
+    assert np.array_equal(
+        np.asarray(observed.packed_s), np.asarray(plain.packed_s)
+    )
+    assert reporter.summary()["converged"]
+    assert reporter.records[-1].derivations == plain.derivations
 
 
 def test_saturate_observed_matches_saturate():
