@@ -70,7 +70,13 @@ class IncrementalClassifier:
             self.config.max_iterations,
             initial=self._state,
         )
-        self._state = (result.s, result.r)
+        if result.transposed:
+            # keep the closure packed (32x smaller than the unpacked
+            # bool square; embed_state re-embeds packed rows verbatim)
+            result._fetch()
+            self._state = (result.packed_s, result.packed_r)
+        else:
+            self._state = (result.s, result.r)
         self.increment += 1
         self.history.append(
             {

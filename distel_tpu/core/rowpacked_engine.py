@@ -73,12 +73,8 @@ from distel_tpu.core.engine import (
     observed_loop,
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
-from distel_tpu.ops.bitpack import (
-    SegmentedRowOr,
-    bit_lookup,
-    pack_planes,
-    unpack_words_planes,
-)
+from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
+from distel_tpu.ops.bitpack import SegmentedRowOr, bit_lookup
 
 
 class RowPackedSaturationEngine:
@@ -97,6 +93,7 @@ class RowPackedSaturationEngine:
         mesh: Optional[jax.sharding.Mesh] = None,
         word_axis: str = "c",
         temp_budget_bytes: int = 1 << 29,
+        use_pallas: Optional[bool] = None,
     ):
         self.idx = idx
         self.unroll = max(int(unroll), 1)
@@ -160,15 +157,40 @@ class RowPackedSaturationEngine:
 
         # Bound per-rule temporaries by splitting each rule into chunks at
         # segment boundaries: a fused application materializes O(K·wc)
-        # gather/scan buffers (CR1-CR3) or an O(K·nc) i32 matmul output
-        # (CR4/CR6) — unchunked, either exceeds HBM near 100k concepts.
+        # gather/scan buffers (CR1-CR3) or — on the XLA matmul fallback —
+        # an O(K·nc) i32 product (CR4/CR6); unchunked, either exceeds HBM
+        # near 100k concepts.  The Pallas kernel keeps CR4/CR6 packed end
+        # to end, so there the chunk bound is only the packed output.
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        self._use_pallas = use_pallas
         gather_rows = max(temp_budget_bytes // (self.wc * 4), 1)
-        mm_rows = max(temp_budget_bytes // 2 // (self.nc * 4), 1)
+        mm_rows = (
+            gather_rows
+            if use_pallas
+            else max(temp_budget_bytes // 2 // (self.nc * 4), 1)
+        )
         self._cr1_chunks = self._p1.split(gather_rows)
         self._cr2_chunks = self._p2.split(gather_rows // 2)
         self._cr3_chunks = self._p3.split(gather_rows)
         self._cr4_chunks = self._p4.split(mm_rows) if self._p4 else []
         self._cr6_chunks = self._p6.split(mm_rows) if self._p6 else []
+        # one packed-output matmul plan per chunk (shard-local width).
+        # dtype: forwarded only when the caller pinned one — the Pallas
+        # kernel's own default (bf16 on TPU) wins otherwise; the engine's
+        # int8 preference applies to the XLA-formulated lookups/tables
+        mm_kw = {"use_xla": not use_pallas}
+        if matmul_dtype is not None:
+            mm_kw["dtype"] = matmul_dtype
+        wl = self.wc // self.n_shards
+        self._cr4_mm = [
+            PackedColsMatmulPlan(sl.stop - sl.start, self.nl, wl, **mm_kw)
+            for sl, _ in self._cr4_chunks
+        ]
+        self._cr6_mm = [
+            PackedColsMatmulPlan(sl.stop - sl.start, self.nl, wl, **mm_kw)
+            for sl, _ in self._cr6_chunks
+        ]
 
         # live-column word mask: bits for x < n_concepts only
         wmask = np.zeros(self.wc, np.uint32)
@@ -186,6 +208,7 @@ class RowPackedSaturationEngine:
         else:
             self._state_sharding = None
         self._step_jit = jax.jit(self._step)
+        self._step_sharded = None
         self._initial_jit = None
         self._observe_jit = None
         self._live_bits_jit = None
@@ -222,11 +245,20 @@ class RowPackedSaturationEngine:
         return self._initial_jit()
 
     def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:
-        """Embed an *unpacked x-major* bool state (``SaturationResult.s`` /
-        ``.r`` from any engine) into this engine's transposed packed
-        arrays — the incremental/resume path.  The base init and the old
-        block are built packed (never the padded [nc, nc] dense square,
-        which would cap resume at the dense engine's memory ceiling)."""
+        """Embed a previous closure into this engine's (possibly larger)
+        transposed packed arrays — the incremental/resume path.
+
+        Accepts either *unpacked x-major* bool arrays
+        (``SaturationResult.s`` / ``.r`` from any engine) or *packed
+        transposed* uint32 arrays (``SaturationResult.packed_s`` /
+        ``.packed_r`` of a row-packed result, dispatched on dtype) — the
+        packed form never densifies and is 32x smaller end to end.
+        Packed-row reuse is sound because concept ids are append-only and
+        an old run's padded x-columns evolve exactly as fresh concepts
+        with S(x)={x,⊤} and no axioms — i.e. the correct warm start for
+        ids later assigned to new concepts."""
+        if np.asarray(s_old).dtype == np.uint32:
+            return self._embed_packed(np.asarray(s_old), np.asarray(r_old))
         s_old = np.asarray(s_old, bool)
         r_old = np.asarray(r_old, bool)
 
@@ -251,6 +283,28 @@ class RowPackedSaturationEngine:
         nl = min(r_old.shape[1], self.nl)
         pr = pack_rows(r_old[:nx, :nl].T)
         rp[:nl, : pr.shape[1]] |= pr
+        if self._state_sharding is not None:
+            return (
+                jax.device_put(sp, self._state_sharding),
+                jax.device_put(rp, self._state_sharding),
+            )
+        return jnp.asarray(sp), jnp.asarray(rp)
+
+    def _embed_packed(
+        self, sp_old: np.ndarray, rp_old: np.ndarray
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Copy packed transposed state into the (grown) arrays: stable
+        ids mean old words land verbatim in the low words of each row."""
+        rows = np.arange(self.nc)
+        sp = np.zeros((self.nc, self.wc), np.uint32)
+        sp[rows, rows >> 5] = np.uint32(1) << (rows & 31).astype(np.uint32)
+        sp[TOP_ID, :] = np.uint32(0xFFFFFFFF)
+        na = min(sp_old.shape[0], self.nc)
+        nw = min(sp_old.shape[1], self.wc)
+        sp[:na, :nw] |= sp_old[:na, :nw]
+        rp = np.zeros((self.nl, self.wc), np.uint32)
+        nl = min(rp_old.shape[0], self.nl)
+        rp[:nl, :nw] = rp_old[:nl, :nw]
         if self._state_sharding is not None:
             return (
                 jax.device_put(sp, self._state_sharding),
@@ -287,7 +341,6 @@ class RowPackedSaturationEngine:
         axis_name: Optional[str] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         m4, m6 = self._masks if masks is None else masks
-        dt = self.matmul_dtype
         # CR1: a ⊑ b
         for sl, plan in self._cr1_chunks:
             sp = plan.apply(sp, sp[self._src1[sl]])
@@ -297,36 +350,20 @@ class RowPackedSaturationEngine:
         # CR3: a ⊑ ∃link
         for sl, plan in self._cr3_chunks:
             rp = plan.apply(rp, sp[self._src3[sl]])
-        if self._p4 is not None or self._p6 is not None:
-            # unpack R_T's (local) columns once for both MXU contractions —
-            # bit-plane-major, so no 8-byte-per-bit intermediate exists
-            # and the matmul outputs repack with pack_planes.  This is the
-            # one temporary temp_budget_bytes does NOT bound (nl*nc_local
-            # int8); on a single chip it caps out around nl*nc ≈ HBM/4,
-            # and the sharded path bounds it naturally (each shard unpacks
-            # only its word slice).  Removing it entirely needs a Pallas
-            # matmul kernel with packed output columns.
-            runp = unpack_words_planes(rp, dt)
-        # CR4: ∃s.a ⊑ b
+        # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
+        # HBM end to end (the Pallas kernel unpacks/repacks per VMEM tile;
+        # the XLA fallback materializes the wide operands instead)
         if self._p4 is not None:
-            for sl, plan in self._cr4_chunks:
+            for (sl, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
                 f4 = self._bit_table(sp, self._a4[sl], axis_name)  # [nl, ck]
                 w = m4[sl] * f4.T
-                out = (
-                    jnp.matmul(w, runp, preferred_element_type=jnp.int32)
-                    > 0
-                )
-                sp = plan.apply(sp, pack_planes(out))
+                sp = plan.apply(sp, mm(w, rp))
         # CR6: role chains
         if self._p6 is not None:
-            for sl, plan in self._cr6_chunks:
+            for (sl, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
                 f6 = self._bit_table(rp, self._l26[sl], axis_name)  # [nl, ck]
                 d = m6[sl] * f6.T
-                out = (
-                    jnp.matmul(d, runp, preferred_element_type=jnp.int32)
-                    > 0
-                )
-                rp = plan.apply(rp, pack_planes(out))
+                rp = plan.apply(rp, mm(d, rp))
         # CR5: ⊥ back-propagation — one masked packed OR-reduce
         if self._bottom:
             botf = self._bit_table(sp, np.full(1, BOTTOM_ID), axis_name)
@@ -339,7 +376,28 @@ class RowPackedSaturationEngine:
         return sp, rp
 
     def step(self, sp, rp):
-        return self._step_jit(sp, rp, self._masks)
+        """One superstep.  On a mesh engine the matmul plans are sized to
+        the shard-local word width, so the step runs inside the same
+        shard_map structure as the fixed point."""
+        if self.mesh is None:
+            return self._step_jit(sp, rp, self._masks)
+        if self._step_sharded is None:
+            P = jax.sharding.PartitionSpec
+            axis = self.word_axis
+            self._step_sharded = jax.jit(
+                jax.shard_map(
+                    lambda sp, rp, masks: self._step(sp, rp, masks, axis),
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(None, axis),
+                        P(None, axis),
+                        (P(None, None), P(None, None)),
+                    ),
+                    out_specs=(P(None, axis), P(None, axis)),
+                    check_vma=False,
+                )
+            )
+        return self._step_sharded(sp, rp, self._masks)
 
     # -------------------------------------------------------- fixed point
 

@@ -197,6 +197,142 @@ class PackedMatmulPlan:
         return (prod > 0).astype(jnp.int8)[: self.m, : self.n]
 
 
+def _packed_cols_kernel(a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int):
+    """Grid (i, j, k), k innermost; acc [TM, 32*TW] f32 persists across k.
+    B tiles are packed uint32 words; unpack/repack happen entirely in
+    VMEM, bit-plane-major via lane-aligned static slices (no sub-lane
+    reshapes, which blow up Mosaic lowering)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    one = jnp.asarray(1, jnp.uint32)
+    b = b_ref[:]                                        # [TL, TW] uint32
+    bits = jnp.concatenate(
+        [
+            ((b >> jnp.asarray(p, jnp.uint32)) & one).astype(jnp.int32)
+            for p in range(32)
+        ],
+        axis=1,
+    ).astype(dtype)                                     # [TL, 32*TW]
+    a = a_ref[:].astype(jnp.int32).astype(dtype)        # [TM, TL]
+    acc_ref[:] += jnp.dot(a, bits, preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _():
+        hit = acc_ref[:] > 0                            # [TM, 32*TW]
+        word = jnp.zeros(o_ref.shape, jnp.uint32)
+        for p in range(32):
+            word |= hit[:, p * tw : (p + 1) * tw].astype(jnp.uint32) << p
+        o_ref[:] = word
+
+
+class PackedColsMatmulPlan:
+    """AND-OR semiring matmul with **packed output columns**:
+    ``C_packed = pack_x((A ⊙ unpack_x(B_packed)))``
+
+        A         [M, L]  int8/bool — per-step operand (axiom masks)
+        B_packed  [L, W]  uint32    — state operand, 32 x-columns/word
+        C_packed  [M, W]  uint32
+
+    The complement of :class:`PackedMatmulPlan` (which packs A along the
+    *contraction* axis): here the contraction axis L is narrow (the link
+    table) and the wide output x-axis stays packed end to end — B is
+    unpacked and C repacked per VMEM tile, so the byte-per-bit [L, 32W]
+    operand and the 4-byte-per-bit [M, 32W] i32 product that the XLA
+    formulation materializes in HBM never exist.  This is CR4/CR6 of the
+    row-packed engine (reference: the two-sided join of
+    ``RolePairHandler.java:421-425`` / ``base/Type5AxiomProcessorBase.java``).
+
+    ``use_xla=True`` computes the same contract with plain XLA ops — the
+    reference implementation and the non-TPU fallback."""
+
+    def __init__(
+        self,
+        m: int,
+        l: int,
+        w: int,
+        *,
+        tm: int = 512,
+        tl: int = 256,
+        tw: int = 128,
+        dtype=None,
+        interpret: bool = False,
+        use_xla: Optional[bool] = None,
+    ):
+        self.m, self.l, self.w = m, l, w
+        self.tm, self.tl, self.tw = tm, tl, tw
+        self.m_p = _pad_up(max(m, 1), tm)
+        self.l_p = _pad_up(max(l, 1), tl)
+        self.w_p = _pad_up(max(w, 1), tw)
+        if dtype is None:
+            dtype = (
+                jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            )
+        self.interpret = interpret
+        if use_xla is None:
+            use_xla = jax.default_backend() != "tpu" and not interpret
+        self.use_xla = use_xla
+        if not use_xla and jnp.issubdtype(dtype, jnp.integer):
+            # Mosaic's MXU path requires float operands with the f32
+            # accumulator; bf16 is exact here (0/1 products, < 2^24 terms)
+            dtype = jnp.bfloat16
+        self.dtype = dtype
+
+    def __call__(self, a: jax.Array, b_packed: jax.Array) -> jax.Array:
+        """a [m, l] int8/bool; b_packed [l, w] uint32 → [m, w] uint32."""
+        if self.use_xla:
+            return self._xla(a, b_packed)
+        a = jnp.pad(
+            a.astype(jnp.int8),
+            ((0, self.m_p - a.shape[0]), (0, self.l_p - a.shape[1])),
+        )
+        b = jnp.pad(
+            b_packed,
+            ((0, self.l_p - b_packed.shape[0]), (0, self.w_p - b_packed.shape[1])),
+        )
+        out = pl.pallas_call(
+            functools.partial(
+                _packed_cols_kernel, dtype=self.dtype, tw=self.tw
+            ),
+            grid=(self.m_p // self.tm, self.w_p // self.tw, self.l_p // self.tl),
+            in_specs=[
+                pl.BlockSpec(
+                    (self.tm, self.tl),
+                    lambda i, j, k: (i, k),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (self.tl, self.tw),
+                    lambda i, j, k: (k, j),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (self.tm, self.tw),
+                lambda i, j, k: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((self.m_p, self.w_p), jnp.uint32),
+            scratch_shapes=[pltpu.VMEM((self.tm, 32 * self.tw), jnp.float32)],
+            interpret=self.interpret,
+        )(a, b)
+        return out[: self.m, : self.w]
+
+    def _xla(self, a: jax.Array, b_packed: jax.Array) -> jax.Array:
+        """Reference/fallback: plane-major unpack → matmul → threshold →
+        repack (materializes the wide operands the kernel avoids)."""
+        from distel_tpu.ops.bitpack import pack_planes, unpack_words_planes
+
+        bits = unpack_words_planes(b_packed, jnp.int8)
+        prod = jnp.matmul(
+            a.astype(jnp.int8), bits, preferred_element_type=jnp.int32
+        )
+        return pack_planes(prod > 0)
+
+
 def packed_andor_matmul(
     a: jax.Array, b_logical: jax.Array, **plan_kw
 ) -> jax.Array:

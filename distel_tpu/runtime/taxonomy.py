@@ -148,6 +148,10 @@ def extract_taxonomy(
 ) -> Taxonomy:
     """``method``: "auto" (device when the result is packed and the
     signature fits), "device", or "host"."""
+    if method not in ("auto", "device", "host"):
+        raise ValueError(
+            f"unknown method {method!r}: expected 'auto', 'device' or 'host'"
+        )
     orig, names = _signature(result.idx)
     if len(orig) == 0:
         return Taxonomy({}, {}, {}, [])

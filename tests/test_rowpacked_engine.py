@@ -204,6 +204,42 @@ def test_sharded_rowpacked_synthetic(mesh8):
     assert (sharded.s[:n, :n] == local.s[:n, :n]).all()
 
 
+def test_sharded_rowpacked_public_step(mesh8):
+    # step() on a mesh engine must run shard_map-structured (the matmul
+    # plans are sized to the shard-local width — regression test)
+    norm, idx = _indexed(BOTTOM_ONTO)
+    local = RowPackedSaturationEngine(idx)
+    sharded = RowPackedSaturationEngine(idx, mesh=mesh8)
+    ls = local.step(*local.initial_state())
+    ss = sharded.step(*sharded.initial_state())
+    n, nl = idx.n_concepts, idx.n_links
+
+    def unpack(p, m):
+        b = np.unpackbits(
+            np.ascontiguousarray(np.asarray(p)).view(np.uint8),
+            axis=1,
+            bitorder="little",
+        )
+        return b[:, :m]
+
+    # compare the live [rows, x] region (padded shapes differ per mesh)
+    assert (unpack(ss[0], n)[:n] == unpack(ls[0], n)[:n]).all()
+    assert (unpack(ss[1], n)[:nl] == unpack(ls[1], n)[:nl]).all()
+
+
+def test_rowpacked_packed_resume_matches_unpacked(small):
+    # resume from the packed transposed closure (no dense square) must
+    # equal resume from the unpacked state
+    norm, idx = small
+    eng = RowPackedSaturationEngine(idx)
+    full = eng.saturate()
+    full._fetch()
+    a = eng.saturate(initial=(full.packed_s, full.packed_r))
+    b = eng.saturate(initial=(full.s, full.r))
+    assert a.derivations == 0 and b.derivations == 0
+    assert (np.asarray(a.packed_s) == np.asarray(b.packed_s)).all()
+
+
 def test_sharded_rowpacked_state_is_sharded(mesh8):
     norm, idx = _indexed(BOTTOM_ONTO)
     eng = RowPackedSaturationEngine(idx, mesh=mesh8)
