@@ -94,7 +94,16 @@ class RowPackedSaturationEngine:
         word_axis: str = "c",
         temp_budget_bytes: int = 1 << 29,
         use_pallas: Optional[bool] = None,
+        rules: Optional[frozenset] = None,
     ):
+        """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
+        all) — the per-rule backend plugin boundary: rules routed to
+        another backend (``core/hybrid.py``) are excluded here."""
+        if rules is not None:
+            unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
+            if unknown:
+                raise ValueError(f"unknown rules: {sorted(unknown)}")
+        self._rules = rules
         self.idx = idx
         self.unroll = max(int(unroll), 1)
         self.mesh = mesh
@@ -110,14 +119,23 @@ class RowPackedSaturationEngine:
         # int8 × int8 → int32 runs 2x bf16 on the MXU and is exact
         self.matmul_dtype = jnp.int8 if matmul_dtype is None else matmul_dtype
 
+        def on(rule: str) -> bool:
+            return rules is None or rule in rules
+
+        empty2 = np.zeros((0, 2), np.int64)
+        empty3 = np.zeros((0, 3), np.int64)
+
         # --- per-rule static plans: sources permuted into seg-OR order
-        self._p1 = SegmentedRowOr(idx.nf1[:, 1])
-        self._src1 = idx.nf1[self._p1.order, 0]
-        self._p2 = SegmentedRowOr(idx.nf2[:, 2])
-        self._src2a = idx.nf2[self._p2.order, 0]
-        self._src2b = idx.nf2[self._p2.order, 1]
-        self._p3 = SegmentedRowOr(idx.nf3[:, 1])
-        self._src3 = idx.nf3[self._p3.order, 0]
+        nf1 = idx.nf1 if on("CR1") else empty2
+        self._p1 = SegmentedRowOr(nf1[:, 1])
+        self._src1 = nf1[self._p1.order, 0]
+        nf2 = idx.nf2 if on("CR2") else empty3
+        self._p2 = SegmentedRowOr(nf2[:, 2])
+        self._src2a = nf2[self._p2.order, 0]
+        self._src2b = nf2[self._p2.order, 1]
+        nf3 = idx.nf3 if on("CR3") else empty2
+        self._p3 = SegmentedRowOr(nf3[:, 1])
+        self._src3 = nf3[self._p3.order, 0]
 
         h = idx.role_closure
         link_roles = idx.links[:, 0] if idx.n_links else np.zeros(0, np.int64)
@@ -132,7 +150,7 @@ class RowPackedSaturationEngine:
         # into every (remote) compile request, which breaks past ~100 MB.
         self._p4 = None
         m4 = np.zeros((0, 0), np.int8)
-        if len(idx.nf4) and idx.n_links:
+        if len(idx.nf4) and idx.n_links and on("CR4"):
             self._p4 = SegmentedRowOr(idx.nf4[:, 2])
             nf4o = idx.nf4[self._p4.order]
             self._a4 = nf4o[:, 1]
@@ -144,7 +162,7 @@ class RowPackedSaturationEngine:
         # CR6: chain second legs, same layout
         self._p6 = None
         m6 = np.zeros((0, 0), np.int8)
-        if len(idx.chain_pairs) and idx.n_links:
+        if len(idx.chain_pairs) and idx.n_links and on("CR6"):
             self._p6 = SegmentedRowOr(idx.chain_pairs[:, 2])
             cpo = idx.chain_pairs[self._p6.order]
             self._l26 = cpo[:, 1]
@@ -153,7 +171,9 @@ class RowPackedSaturationEngine:
             m6[:, : idx.n_links] = h.T[cpo[:, 0]][:, link_roles].astype(np.int8)
         self._masks = (jnp.asarray(m4), jnp.asarray(m6))
 
-        self._bottom = bool(idx.has_bottom_axioms and idx.n_links)
+        self._bottom = bool(
+            idx.has_bottom_axioms and idx.n_links and on("CR5")
+        )
 
         # Bound per-rule temporaries by splitting each rule into chunks at
         # segment boundaries: a fused application materializes O(K·wc)

@@ -62,13 +62,33 @@ class ClassificationResult:
 def make_engine(config: ClassifierConfig, idx: IndexedOntology, mesh=None):
     """Engine selection: the row-packed transposed engine is the flagship
     (fastest measured on TPU and 8x the dense concept ceiling); "dense"
-    and "packed" remain the reference paths."""
+    and "packed" remain the reference paths.  ``rule_backends`` entries
+    routing rules off-device wrap the row-packed engine in the hybrid
+    saturator (the reference's rule→node plugin boundary)."""
     choice = "rowpacked" if config.engine == "auto" else config.engine
+    if choice not in ("rowpacked", "packed", "dense"):
+        raise ValueError(
+            f"unknown engine {config.engine!r}: expected 'auto', "
+            "'rowpacked', 'packed' or 'dense'"
+        )
     kw = dict(
         pad_multiple=config.pad_multiple,
         mesh=mesh,
         matmul_dtype=config.matmul_jnp_dtype(),
     )
+    if config.rule_backends:
+        from distel_tpu.core.hybrid import HybridSaturator, split_backends
+
+        _, host_rules = split_backends(config.rule_backends)
+        if host_rules:
+            if choice != "rowpacked":
+                raise ValueError(
+                    "rule_backends routing rules to the host requires the "
+                    f"rowpacked engine, but engine={config.engine!r}"
+                )
+            return HybridSaturator(
+                idx, config.rule_backends, engine_kw=kw
+            )
     if choice == "rowpacked":
         from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
 
@@ -77,11 +97,6 @@ def make_engine(config: ClassifierConfig, idx: IndexedOntology, mesh=None):
         from distel_tpu.core.packed_engine import PackedSaturationEngine
 
         return PackedSaturationEngine(idx, **kw)
-    if choice != "dense":
-        raise ValueError(
-            f"unknown engine {config.engine!r}: expected 'auto', "
-            "'rowpacked', 'packed' or 'dense'"
-        )
     return SaturationEngine(idx, **kw)
 
 

@@ -1,0 +1,103 @@
+"""Per-rule backend routing (core/hybrid.py): rules routed to the host
+backend must yield the identical closure as the all-TPU engine — the
+plugin-boundary parity of the reference's rule→node assignment."""
+
+import numpy as np
+import pytest
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.core.hybrid import HybridSaturator, split_backends
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import synthetic_ontology
+from distel_tpu.owl import parser
+from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+from test_packed_engine import BOTTOM_ONTO
+
+
+def _indexed(text):
+    norm = normalize(parser.parse(text))
+    return norm, index_ontology(norm)
+
+
+def test_split_backends_validates():
+    assert split_backends({}) == (
+        frozenset(f"CR{i}" for i in range(1, 7)),
+        frozenset(),
+    )
+    tpu, host = split_backends({"CR4": "redis", "CR1": "tpu"})
+    assert host == {"CR4"} and "CR1" in tpu
+    with pytest.raises(ValueError, match="unknown rule"):
+        split_backends({"CR9": "tpu"})
+    with pytest.raises(ValueError, match="unknown backend"):
+        split_backends({"CR1": "gpu"})
+
+
+@pytest.mark.parametrize(
+    "routed",
+    [{"CR4": "host"}, {"CR1": "cpu"}, {"CR5": "oracle", "CR6": "redis"}],
+)
+def test_hybrid_matches_all_tpu(routed):
+    norm, idx = _indexed(BOTTOM_ONTO)
+    full = RowPackedSaturationEngine(idx).saturate()
+    hybrid = HybridSaturator(idx, routed).saturate()
+    n, nl = idx.n_concepts, idx.n_links
+    assert (hybrid.s[:n, :n] == full.s[:n, :n]).all()
+    assert (hybrid.r[:n, :nl] == full.r[:n, :nl]).all()
+    assert hybrid.derivations == full.derivations
+    report = diff_engine_vs_oracle(norm, hybrid)
+    assert report.ok(), report.summary()
+
+
+def test_hybrid_synthetic_all_host_rules():
+    norm, idx = _indexed(
+        synthetic_ontology(
+            n_classes=200, n_anatomy=40, n_locations=25, n_definitions=15
+        )
+    )
+    full = RowPackedSaturationEngine(idx).saturate()
+    routed = {f"CR{i}": "host" for i in range(1, 7)}
+    hybrid = HybridSaturator(idx, routed).saturate()
+    n = idx.n_concepts
+    assert (hybrid.s[:n, :n] == full.s[:n, :n]).all()
+
+
+def test_classifier_rule_backends():
+    cfg = ClassifierConfig(
+        rule_backends={"CR4": "host"}, use_native_loader=False
+    )
+    from distel_tpu.runtime.classifier import ELClassifier
+
+    res = ELClassifier(cfg).classify_text(BOTTOM_ONTO)
+    assert "CatDog" in res.taxonomy.unsatisfiable
+
+
+def test_engine_rules_subset_validation():
+    _, idx = _indexed("SubClassOf(A B)")
+    with pytest.raises(ValueError, match="unknown rules"):
+        RowPackedSaturationEngine(idx, rules=frozenset({"CR7"}))
+
+
+def test_hybrid_deep_host_chain_converges():
+    # a host-routed CR1 chain deeper than the round cap: the host pass
+    # must iterate to its own fixed point within a round (regression)
+    depth = 300
+    text = "\n".join(f"SubClassOf(C{i} C{i+1})" for i in range(depth))
+    norm, idx = _indexed(text)
+    hybrid = HybridSaturator(idx, {"CR1": "host"}).saturate()
+    top = idx.concept_ids[f"C{depth}"]
+    bottom = idx.concept_ids["C0"]
+    assert hybrid.s[bottom, top]
+    assert hybrid.converged
+
+
+def test_hybrid_requires_rowpacked_engine():
+    from distel_tpu.runtime.classifier import ELClassifier
+
+    cfg = ClassifierConfig(
+        engine="dense", rule_backends={"CR4": "host"}, use_native_loader=False
+    )
+    with pytest.raises(ValueError, match="requires the"):
+        ELClassifier(cfg).classify_text("SubClassOf(A B)")
