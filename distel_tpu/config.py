@@ -54,6 +54,12 @@ class ClassifierConfig:
     #: use the C++ load plane (native/distel_loader.cpp) when available —
     #: ~13x faster text→tensors than the Python frontend
     use_native_loader: bool = True
+    #: multi-host (DCN) wiring: when set, ELClassifier joins the JAX
+    #: multi-controller runtime before building the mesh
+    #: (distel_tpu/parallel/mesh.py — the NODES_LIST analog)
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
     #: state representation: "rowpacked" (transposed uint32 bitsets,
     #: scatter-free — the flagship: fastest measured and 8x the dense
     #: concept ceiling), "dense" (bool arrays, the simplest reference
@@ -94,6 +100,12 @@ class ClassifierConfig:
             cfg.normalize_cache_path = raw["normalize.cache.path"]
         if "native.loader" in raw:
             cfg.use_native_loader = raw["native.loader"].lower() == "true"
+        if "coordinator.address" in raw:
+            cfg.coordinator_address = raw["coordinator.address"]
+        if "num.processes" in raw:
+            cfg.num_processes = int(raw["num.processes"])
+        if "process.id" in raw:
+            cfg.process_id = int(raw["process.id"])
         if "engine" in raw:
             cfg.engine = raw["engine"]
         for k, v in raw.items():

@@ -330,6 +330,12 @@ class SaturationEngine:
         land in the top-left block; new rows get the S(X)={X,⊤} init."""
         s_old = np.asarray(s_old)
         r_old = np.asarray(r_old)
+        if s_old.dtype == np.uint32:
+            raise TypeError(
+                "packed transposed state (uint32) is only understood by "
+                "the row-packed engine; pass unpacked bool arrays (e.g. "
+                "load_snapshot_state(path, unpack=True))"
+            )
         no, lo = s_old.shape[0], r_old.shape[1]
         if (no, s_old.shape[1], lo) == (self.nc, self.nc, self.nl):
             s, r = jnp.asarray(s_old), jnp.asarray(r_old)

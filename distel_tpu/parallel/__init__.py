@@ -1,0 +1,3 @@
+"""Device-mesh and multi-host topology utilities."""
+
+from distel_tpu.parallel.mesh import build_mesh, init_distributed  # noqa: F401

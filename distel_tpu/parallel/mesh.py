@@ -1,0 +1,83 @@
+"""Mesh construction and multi-host initialization.
+
+The reference's cluster topology is a node list plus per-rule weights
+(``ShardInfo.properties:5-22``), wired by ssh/pssh fan-out
+(``scripts/init.sh``, ``scripts/classify-all.sh``); its communication
+backend is Redis TCP.  The TPU-native equivalents:
+
+* **Within a host (ICI):** one ``jax.sharding.Mesh`` over the local
+  chips; the engines shard the packed word axis and every collective
+  (the filler bit-table ``psum``, the convergence vote) rides ICI.
+* **Across hosts (DCN):** JAX's multi-controller runtime —
+  ``jax.distributed.initialize`` connects the processes, after which
+  ``jax.devices()`` spans every host and the same mesh code produces a
+  global mesh.  XLA routes collectives over ICI within a slice and DCN
+  across, with no change to the engine (the sharded fixed point is
+  topology-agnostic; the word-axis layout keeps per-step traffic to the
+  small bit-tables, which is what makes DCN hops tolerable — the analog
+  of the reference keeping only barrier votes and delta reads
+  cross-node, ``controller/CommunicationHandler.java:42-84``).
+* Host-side work (parse/normalize/index) runs on every process over the
+  same input — cheap, deterministic, and replica-consistent, matching
+  the reference's loader writing identical metadata to every node
+  (``init/AxiomLoader.java:365-413``).
+
+Config keys (``ClassifierConfig.from_properties``): ``coordinator.address``,
+``process.id``, ``num.processes`` — the ``NODES_LIST`` analog for the
+multi-controller world.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-controller runtime (idempotent).  Returns True if
+    distributed mode is active.  With no coordinator configured this is
+    a no-op — the single-process path."""
+    if coordinator_address is None:
+        return False
+    import jax
+
+    # the idempotency check must NOT touch the backend (jax.process_count
+    # would initialize XLA, after which distributed.initialize refuses to
+    # run) — inspect the distributed client state directly
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return True
+    except (ImportError, AttributeError):
+        pass  # private-API drift: fall through to initialize()
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def build_mesh(
+    n_devices: Optional[int] = None, axis: str = "c"
+):
+    """A 1-D mesh over the (global, under multi-host) device list.
+    ``n_devices=None`` takes every device; the engines require the
+    packed word axis to divide by the mesh size, which they arrange via
+    padding."""
+    import jax
+    import numpy as np
+
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"mesh of {n_devices} devices requested but only "
+                f"{len(devs)} present"
+            )
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.array(devs), (axis,))

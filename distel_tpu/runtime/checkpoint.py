@@ -22,20 +22,8 @@ from distel_tpu.core.indexing import IndexedOntology
 
 
 def save_snapshot(path: str, result: SaturationResult) -> None:
-    # On-disk format is deliberately distinct from the engine's uint32 wire
-    # packing: snapshots slice away the padded rows/columns (word alignment
-    # would forbid that on the packed form) and use np.packbits so the file
-    # is self-describing with plain numpy at load time.
     idx = result.idx
-    n = idx.n_concepts
-    s = result.s[:n, :n]
-    r = result.r[:n]
-    np.savez_compressed(
-        path,
-        s_packed=np.packbits(s, axis=1),
-        r_packed=np.packbits(r, axis=1),
-        s_cols=np.int64(s.shape[1]),
-        r_cols=np.int64(r.shape[1]),
+    common = dict(
         iterations=np.int64(result.iterations),
         derivations=np.int64(result.derivations),
         concept_names=np.array(idx.concept_names, dtype=object),
@@ -46,17 +34,37 @@ def save_snapshot(path: str, result: SaturationResult) -> None:
             dtype=object,
         ),
     )
+    if result.transposed:
+        # v2: the row-packed engine's wire form verbatim (subsumer-major
+        # uint32 rows) — saving never densifies the nc² square, and
+        # resume re-embeds the words directly (ids are append-only)
+        result._fetch()
+        np.savez_compressed(
+            path,
+            s_wire=np.asarray(result.packed_s),
+            r_wire=np.asarray(result.packed_r),
+            n_concepts=np.int64(idx.n_concepts),
+            n_links=np.int64(idx.n_links),
+            **common,
+        )
+        return
+    # v1: padded rows/columns sliced away, np.packbits layout — fully
+    # self-describing with plain numpy at load time
+    n = idx.n_concepts
+    s = result.s[:n, :n]
+    r = result.r[:n]
+    np.savez_compressed(
+        path,
+        s_packed=np.packbits(s, axis=1),
+        r_packed=np.packbits(r, axis=1),
+        s_cols=np.int64(s.shape[1]),
+        r_cols=np.int64(r.shape[1]),
+        **common,
+    )
 
 
-def load_snapshot(path: str) -> Tuple[np.ndarray, np.ndarray, dict]:
-    """Returns (S, R, info).  S/R are unpacked bool arrays over the logical
-    (unpadded) universe; info carries names/links/counters."""
-    z = np.load(path, allow_pickle=True)
-    s_cols = int(z["s_cols"])
-    r_cols = int(z["r_cols"])
-    s = np.unpackbits(z["s_packed"], axis=1)[:, :s_cols].astype(bool)
-    r = np.unpackbits(z["r_packed"], axis=1)[:, :r_cols].astype(bool)
-    info = {
+def _info(z) -> dict:
+    return {
         "iterations": int(z["iterations"]),
         "derivations": int(z["derivations"]),
         "concept_names": list(z["concept_names"]),
@@ -64,7 +72,55 @@ def load_snapshot(path: str) -> Tuple[np.ndarray, np.ndarray, dict]:
         "links": z["links"],
         "meta": json.loads(str(z["meta"][0])),
     }
-    return s, r, info
+
+
+def load_snapshot_state(
+    path: str, unpack: bool = False
+) -> Tuple[Tuple[np.ndarray, np.ndarray], dict]:
+    """Resume-oriented load: returns ``(state, info)`` where ``state``
+    feeds ``engine.saturate(initial=state)``.  For v2 snapshots the
+    default is the wire-packed uint32 pair, which re-embeds without
+    densifying but is only understood by the **row-packed** engine; pass
+    ``unpack=True`` to get the x-major bool pair any engine accepts."""
+    z = np.load(path, allow_pickle=True)
+    if "s_wire" in z and not unpack:
+        return (z["s_wire"], z["r_wire"]), _info(z)
+    s, r, info = _load_unpacked(z)
+    return (s, r), info
+
+
+def _load_unpacked(z) -> Tuple[np.ndarray, np.ndarray, dict]:
+    if "s_wire" in z:
+        # v2: unpack the wire rows and present the x-major live view
+        n = int(z["n_concepts"])
+        nl = int(z["n_links"])
+        st = np.unpackbits(
+            np.ascontiguousarray(z["s_wire"]).view(np.uint8),
+            axis=1,
+            bitorder="little",
+        )
+        rt = np.unpackbits(
+            np.ascontiguousarray(z["r_wire"]).view(np.uint8),
+            axis=1,
+            bitorder="little",
+        )
+        return (
+            st[:n, :n].T.astype(bool),
+            rt[:nl, :n].T.astype(bool),
+            _info(z),
+        )
+    s_cols = int(z["s_cols"])
+    r_cols = int(z["r_cols"])
+    s = np.unpackbits(z["s_packed"], axis=1)[:, :s_cols].astype(bool)
+    r = np.unpackbits(z["r_packed"], axis=1)[:, :r_cols].astype(bool)
+    return s, r, _info(z)
+
+
+def load_snapshot(path: str) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Returns (S, R, info).  S/R are unpacked x-major bool arrays over
+    the logical (unpadded) universe; info carries names/links/counters."""
+    z = np.load(path, allow_pickle=True)
+    return _load_unpacked(z)
 
 
 class Snapshotter:

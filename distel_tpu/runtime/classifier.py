@@ -14,8 +14,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-import numpy as np
-
 from distel_tpu.config import ClassifierConfig
 from distel_tpu.core.engine import SaturationEngine, SaturationResult
 from distel_tpu.core.indexing import Indexer, IndexedOntology
@@ -106,16 +104,15 @@ class ELClassifier:
     def __init__(self, config: Optional[ClassifierConfig] = None):
         self.config = config or ClassifierConfig()
         self._mesh = None
-        if self.config.mesh_devices:
-            import jax
+        from distel_tpu.parallel import build_mesh, init_distributed
 
-            n = self.config.mesh_devices
-            devs = jax.devices()
-            if len(devs) < n:
-                raise ValueError(
-                    f"mesh_devices={n} but only {len(devs)} devices present"
-                )
-            self._mesh = jax.sharding.Mesh(np.array(devs[:n]), ("c",))
+        init_distributed(
+            self.config.coordinator_address,
+            self.config.num_processes,
+            self.config.process_id,
+        )
+        if self.config.mesh_devices:
+            self._mesh = build_mesh(self.config.mesh_devices)
 
     def _make_engine(self, idx: IndexedOntology):
         return make_engine(self.config, idx, mesh=self._mesh)
