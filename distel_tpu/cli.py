@@ -2,6 +2,7 @@
 ``scripts/*.sh`` (SURVEY.md §2.7), collapsed into subcommands:
 
   classify   run-all.sh / classifier.sh  (load → saturate → taxonomy)
+  stream     traffic-data-load-classify.sh (base + incremental batches)
   normalize  Normalizer standalone main  (init/Normalizer.java:896-943)
   stats      OntologyStats / DataStats census
   check      ProfileChecker report
@@ -43,6 +44,47 @@ def cmd_classify(args) -> int:
 
         save_snapshot(args.snapshot, res.result)
         print(f"snapshot written to {args.snapshot}")
+    return 0
+
+
+def cmd_stream(args) -> int:
+    """Incremental streaming: classify a base ontology, then add each
+    delta file on top of the running closure (the reference's
+    ``traffic-data-load-classify.sh`` loop; implied target there: avg
+    ≤ 20 s per streamed file, ``output/analysis/StatsCollector.java``)."""
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.core.incremental import IncrementalClassifier
+    from distel_tpu.runtime.checkpoint import Snapshotter
+
+    cfg = (
+        ClassifierConfig.from_properties(args.config)
+        if args.config
+        else ClassifierConfig()
+    )
+    inc = IncrementalClassifier(cfg)
+    snap = (
+        Snapshotter(args.snapshot_prefix, args.snapshot_interval)
+        if args.snapshot_prefix
+        else None
+    )
+    for path in [args.base] + args.deltas:
+        t0 = time.time()
+        with open(path, "r", encoding="utf-8") as f:
+            inc.add_text(f.read())
+        rec = dict(inc.history[-1], file=path, wall_s=round(time.time() - t0, 3))
+        print(json.dumps(rec), flush=True)
+        if snap is not None:
+            snap.maybe_snapshot(inc.last_result)
+    print(
+        json.dumps(
+            {
+                "increments": inc.increment,
+                "total_derivations": sum(
+                    h["new_derivations"] for h in inc.history
+                ),
+            }
+        )
+    )
     return 0
 
 
@@ -119,11 +161,12 @@ def cmd_bench(args) -> int:
     from distel_tpu.frontend.normalizer import normalize
     from distel_tpu.owl import loader as parser_compat
     from distel_tpu.core.indexing import index_ontology
-    from distel_tpu.core.engine import SaturationEngine
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.classifier import make_engine
 
     norm = normalize(parser_compat.load_file(args.ontology))
     idx = index_ontology(norm)
-    engine = SaturationEngine(idx)
+    engine = make_engine(ClassifierConfig(), idx)
     times = []
     for i in range(args.repeats + 1):
         t0 = time.time()
@@ -162,6 +205,16 @@ def main(argv=None) -> int:
     c.add_argument("--verify", action="store_true", help="diff vs CPU oracle")
     c.add_argument("--instrument", action="store_true", help="phase timers")
     c.set_defaults(fn=cmd_classify)
+
+    st = sub.add_parser("stream", help="incremental streaming classification")
+    st.add_argument("base")
+    st.add_argument("deltas", nargs="*")
+    st.add_argument("--config", help="properties/config file")
+    st.add_argument(
+        "--snapshot-prefix", help="timed state snapshots (ResultSnapshotter)"
+    )
+    st.add_argument("--snapshot-interval", type=float, default=120.0)
+    st.set_defaults(fn=cmd_stream)
 
     n = sub.add_parser("normalize", help="dump NF1-NF7 normal forms")
     n.add_argument("ontology")

@@ -45,6 +45,18 @@ class IncrementalClassifier:
 
     def __init__(self, config: Optional[ClassifierConfig] = None):
         self.config = config or ClassifierConfig()
+        from distel_tpu.parallel import build_mesh, init_distributed
+
+        init_distributed(
+            self.config.coordinator_address,
+            self.config.num_processes,
+            self.config.process_id,
+        )
+        self._mesh = (
+            build_mesh(self.config.mesh_devices)
+            if self.config.mesh_devices
+            else None
+        )
         self.indexer = Indexer()
         self.accumulated = NormalizedOntology()
         self._normalizer_cache: dict = {}
@@ -65,7 +77,7 @@ class IncrementalClassifier:
         idx = self.indexer.index(self.accumulated)
         from distel_tpu.runtime.classifier import make_engine
 
-        engine = make_engine(self.config, idx)
+        engine = make_engine(self.config, idx, mesh=self._mesh)
         result = engine.saturate(
             self.config.max_iterations,
             initial=self._state,

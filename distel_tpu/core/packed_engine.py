@@ -391,6 +391,12 @@ class PackedSaturationEngine:
     def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:
         """Embed an *unpacked* bool state (e.g. from a snapshot) into this
         engine's packed arrays — the incremental/resume path."""
+        if np.asarray(s_old).dtype == np.uint32:
+            raise TypeError(
+                "packed transposed state (uint32) is only understood by "
+                "the row-packed engine; pass unpacked bool arrays (e.g. "
+                "load_snapshot_state(path, unpack=True))"
+            )
         s_old = np.asarray(s_old, bool)
         r_old = np.asarray(r_old, bool)
         s = np.zeros((self.nc, self.nc), bool)

@@ -79,5 +79,13 @@ def build_mesh(
                 f"mesh of {n_devices} devices requested but only "
                 f"{len(devs)} present"
             )
+        if jax.process_count() > 1 and n_devices != len(devs):
+            # a prefix slice of the global list would exclude every chip
+            # of the later hosts, whose processes then cannot execute
+            # against the mesh — partial meshes are single-host only
+            raise ValueError(
+                f"partial mesh ({n_devices} of {len(devs)} devices) is not "
+                "supported under multi-host; omit mesh_devices to span all"
+            )
         devs = devs[:n_devices]
     return jax.sharding.Mesh(np.array(devs), (axis,))

@@ -92,23 +92,13 @@ def load_snapshot_state(
 def _load_unpacked(z) -> Tuple[np.ndarray, np.ndarray, dict]:
     if "s_wire" in z:
         # v2: unpack the wire rows and present the x-major live view
+        from distel_tpu.core.engine import _unpack_bits_host
+
         n = int(z["n_concepts"])
         nl = int(z["n_links"])
-        st = np.unpackbits(
-            np.ascontiguousarray(z["s_wire"]).view(np.uint8),
-            axis=1,
-            bitorder="little",
-        )
-        rt = np.unpackbits(
-            np.ascontiguousarray(z["r_wire"]).view(np.uint8),
-            axis=1,
-            bitorder="little",
-        )
-        return (
-            st[:n, :n].T.astype(bool),
-            rt[:nl, :n].T.astype(bool),
-            _info(z),
-        )
+        st = _unpack_bits_host(z["s_wire"], n)
+        rt = _unpack_bits_host(z["r_wire"], n)
+        return st[:n].T.copy(), rt[:nl].T.copy(), _info(z)
     s_cols = int(z["s_cols"])
     r_cols = int(z["r_cols"])
     s = np.unpackbits(z["s_packed"], axis=1)[:, :s_cols].astype(bool)

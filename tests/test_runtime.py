@@ -116,6 +116,24 @@ def test_checkpoint_roundtrip(classified, tmp_path):
     assert info["meta"]["converged"] is True
 
 
+def test_cli_stream(tmp_path, capsys):
+    from distel_tpu import cli
+
+    base = tmp_path / "base.ofn"
+    base.write_text("SubClassOf(A B)\nSubClassOf(A ObjectSomeValuesFrom(r C))")
+    d1 = tmp_path / "d1.ofn"
+    d1.write_text("SubClassOf(B D)\nSubClassOf(ObjectSomeValuesFrom(r C) E)")
+    rc = cli.main(
+        ["stream", str(base), str(d1), "--snapshot-prefix",
+         str(tmp_path / "curve"), "--snapshot-interval", "0"]
+    )
+    assert rc == 0
+    lines = [json.loads(x) for x in capsys.readouterr().out.strip().splitlines()]
+    assert lines[-1]["increments"] == 2
+    assert lines[0]["file"] == str(base)
+    assert (tmp_path / "curve.0000.npz").exists()
+
+
 def test_parallel_mesh_and_distributed_config(tmp_path):
     from distel_tpu.parallel import build_mesh, init_distributed
 
