@@ -45,18 +45,9 @@ class IncrementalClassifier:
 
     def __init__(self, config: Optional[ClassifierConfig] = None):
         self.config = config or ClassifierConfig()
-        from distel_tpu.parallel import build_mesh, init_distributed
+        from distel_tpu.parallel import setup
 
-        init_distributed(
-            self.config.coordinator_address,
-            self.config.num_processes,
-            self.config.process_id,
-        )
-        self._mesh = (
-            build_mesh(self.config.mesh_devices)
-            if self.config.mesh_devices
-            else None
-        )
+        self._mesh = setup(self.config)
         self.indexer = Indexer()
         self.accumulated = NormalizedOntology()
         self._normalizer_cache: dict = {}

@@ -74,6 +74,13 @@ class _Reader:
             return S.ObjectOneOf(
                 tuple(S.Individual(self._iri(c)) for c in el)
             )
+        if loc == "ObjectHasValue":
+            # EL sugar: ObjectHasValue(r a) ≡ ∃r.{a}
+            children = list(el)
+            return S.ObjectSomeValuesFrom(
+                S.ObjectProperty(self._iri(children[0])),
+                S.ObjectOneOf((S.Individual(self._iri(children[1])),)),
+            )
         return S.UnsupportedClassExpression(loc)
 
     # ------------------------------------------------------------- axioms

@@ -394,6 +394,14 @@ class Parser:
                 inds.append(self._parse_individual())
             self._end()
             return S.ObjectOneOf(tuple(inds))
+        if name == "ObjectHasValue":
+            # EL sugar: ObjectHasValue(r a) ≡ ∃r.{a} (the reference loads
+            # it as a T3₁ axiom keyed on the individual,
+            # init/AxiomLoader.java:702-711)
+            role = self._parse_role()
+            ind = self._parse_individual()
+            self._end()
+            return S.ObjectSomeValuesFrom(role, S.ObjectOneOf((ind,)))
         # out-of-profile constructor: swallow the group
         payload = self._consume_group_payload()
         return S.UnsupportedClassExpression(name, payload)

@@ -168,6 +168,17 @@ class _AxiomBuilder:
             return S.ObjectOneOf(
                 tuple(S.Individual(m) for m in st.rdf_list(one_of))
             )
+        has_value = st.one(node, f"{OWL}hasValue")
+        if (
+            on_prop is not None
+            and has_value is not None
+            and not has_value.startswith(('_:', '"'))  # not bnode/literal
+        ):
+            # EL sugar: hasValue restriction with an individual ≡ ∃r.{a}
+            return S.ObjectSomeValuesFrom(
+                S.ObjectProperty(on_prop),
+                S.ObjectOneOf((S.Individual(has_value),)),
+            )
         for ctor in (
             "unionOf",
             "complementOf",

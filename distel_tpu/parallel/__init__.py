@@ -1,3 +1,7 @@
 """Device-mesh and multi-host topology utilities."""
 
-from distel_tpu.parallel.mesh import build_mesh, init_distributed  # noqa: F401
+from distel_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    init_distributed,
+    setup,
+)

@@ -62,6 +62,20 @@ def init_distributed(
     return True
 
 
+def setup(config):
+    """Shared classifier bootstrap: join the multi-controller runtime if
+    configured, then build the mesh (or None for single-device).  The
+    order matters — ``build_mesh``'s multi-host guard reads
+    ``jax.process_count()``, which is only accurate after
+    ``init_distributed``."""
+    init_distributed(
+        config.coordinator_address,
+        config.num_processes,
+        config.process_id,
+    )
+    return build_mesh(config.mesh_devices) if config.mesh_devices else None
+
+
 def build_mesh(
     n_devices: Optional[int] = None, axis: str = "c"
 ):

@@ -103,16 +103,9 @@ class ELClassifier:
 
     def __init__(self, config: Optional[ClassifierConfig] = None):
         self.config = config or ClassifierConfig()
-        self._mesh = None
-        from distel_tpu.parallel import build_mesh, init_distributed
+        from distel_tpu.parallel import setup
 
-        init_distributed(
-            self.config.coordinator_address,
-            self.config.num_processes,
-            self.config.process_id,
-        )
-        if self.config.mesh_devices:
-            self._mesh = build_mesh(self.config.mesh_devices)
+        self._mesh = setup(self.config)
 
     def _make_engine(self, idx: IndexedOntology):
         return make_engine(self.config, idx, mesh=self._mesh)

@@ -401,6 +401,21 @@ struct Parser {
         if (inds.size() == 1) return inds[0];
         return mk_expr(E_UNSUP);  // multi-nominal: out of profile
       }
+      if (name == "ObjectHasValue") {
+        // EL sugar: ObjectHasValue(r a) == ObjectSomeValuesFrom(r {a})
+        // (reference loads it as a T3_1 axiom keyed on the individual,
+        // init/AxiomLoader.java:702-711)
+        int32_t r = parse_role(); if (r < 0) return -1;
+        int32_t i = parse_individual(); if (i < 0) return -1;
+        Expr e;
+        e.kind = E_SOME;
+        e.role = r;
+        e.a = i;
+        arena.push_back(std::move(e));
+        int32_t id = (int32_t)arena.size() - 1;
+        if (!expect(T_RPAR)) return -1;
+        return id;
+      }
       // unsupported constructor: swallow group
       if (!consume_group_open()) return -1;
       return mk_expr(E_UNSUP);

@@ -96,6 +96,12 @@ CASES = [
     "SubClassOf(ObjectSomeValuesFrom(s D) E)",
     "EquivalentClasses(A ObjectIntersectionOf(B ObjectSomeValuesFrom(r C)))\n"
     "SubClassOf(X B)\nSubClassOf(X ObjectSomeValuesFrom(r C))",
+    # ObjectHasValue ≡ ∃r.{a} on both sides (regression: the native
+    # parser dropped it as non-EL while the Python parser desugared it)
+    "SubClassOf(Cat ObjectHasValue(owns felix))\n"
+    "SubClassOf(ObjectHasValue(owns felix) PetOwner)\n"
+    "SubClassOf(ObjectSomeValuesFrom(owns ObjectOneOf(felix)) PetOwner2)\n"
+    "SubClassOf(PetOwner Person)",
 ]
 
 

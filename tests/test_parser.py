@@ -121,3 +121,23 @@ def test_nested_intersections():
     assert len(sup.operands) == 3
     some = sup.operands[1]
     assert isinstance(some.filler, S.ObjectIntersectionOf)
+
+
+def test_object_has_value_desugars_to_nominal_existential():
+    # ObjectHasValue(r a) ≡ ∃r.{a} — the reference loads it as a T3₁
+    # axiom keyed on the individual (init/AxiomLoader.java:702-711)
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+
+    text = (
+        "SubClassOf(Cat ObjectHasValue(owns felix))\n"
+        "SubClassOf(ObjectHasValue(owns felix) FelixOwner)\n"
+        "SubClassOf(ObjectSomeValuesFrom(owns ObjectOneOf(felix)) FelixOwner2)\n"
+    )
+    idx = index_ontology(normalize(parser.parse(text)))
+    r = RowPackedSaturationEngine(idx).saturate()
+    subs = {
+        idx.concept_names[i] for i in r.subsumers(idx.concept_ids["Cat"])
+    }
+    assert {"FelixOwner", "FelixOwner2"} <= subs

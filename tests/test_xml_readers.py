@@ -173,3 +173,48 @@ def test_loader_dispatch_classifies():
         cat = S.Class(f"{EX}Cat")
         animal = S.Class(f"{EX}Animal")
         assert animal in sat.subsumers[cat], sorted(map(repr, sat.subsumers[cat]))
+
+
+def test_rdfxml_has_value_restriction():
+    # owl:hasValue with an individual ≡ ∃r.{a}; a literal-valued
+    # hasValue (DataHasValue) stays out of profile
+    text = """<?xml version="1.0"?>
+<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#"
+         xmlns:owl="http://www.w3.org/2002/07/owl#"
+         xmlns:rdfs="http://www.w3.org/2000/01/rdf-schema#"
+         xml:base="http://ex.org/">
+  <owl:NamedIndividual rdf:about="http://ex.org/felix"/>
+  <owl:Class rdf:about="http://ex.org/Cat">
+    <rdfs:subClassOf>
+      <owl:Restriction>
+        <owl:onProperty rdf:resource="http://ex.org/owns"/>
+        <owl:hasValue rdf:resource="http://ex.org/felix"/>
+      </owl:Restriction>
+    </rdfs:subClassOf>
+    <rdfs:subClassOf>
+      <owl:Restriction>
+        <owl:onProperty rdf:resource="http://ex.org/age"/>
+        <owl:hasValue>7</owl:hasValue>
+      </owl:Restriction>
+    </rdfs:subClassOf>
+  </owl:Class>
+</rdf:RDF>"""
+    from distel_tpu.owl import syntax as S
+    from distel_tpu.owl import rdfxml
+
+    onto = rdfxml.parse(text)
+    sups = [
+        ax.sup
+        for ax in onto.axioms
+        if isinstance(ax, S.SubClassOf)
+        and isinstance(ax.sub, S.Class)
+        and ax.sub.iri.endswith("Cat")
+    ]
+    somes = [s for s in sups if isinstance(s, S.ObjectSomeValuesFrom)]
+    assert len(somes) == 1
+    assert isinstance(somes[0].filler, S.ObjectOneOf)
+    assert somes[0].filler.individuals[0].iri.endswith("felix")
+    unsupported = [
+        s for s in sups if isinstance(s, S.UnsupportedClassExpression)
+    ]
+    assert len(unsupported) == 1
