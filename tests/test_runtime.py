@@ -116,6 +116,22 @@ def test_checkpoint_roundtrip(classified, tmp_path):
     assert info["meta"]["converged"] is True
 
 
+def test_cli_bench_engine_bakeoff(tmp_path, capsys):
+    from distel_tpu import cli
+
+    onto = tmp_path / "o.ofn"
+    onto.write_text(ONTO)
+    rc = cli.main(
+        ["bench", str(onto), "--engines", "all,oracle", "--repeats", "1"]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    engines = out["engines"]
+    assert set(engines) == {"rowpacked", "packed", "dense", "oracle"}
+    derivs = {engines[e]["derivations"] for e in ("rowpacked", "packed", "dense")}
+    assert len(derivs) == 1  # identical closure across engines
+
+
 def test_cli_stream(tmp_path, capsys):
     from distel_tpu import cli
 
