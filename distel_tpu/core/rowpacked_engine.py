@@ -230,13 +230,22 @@ class RowPackedSaturationEngine:
             )
         else:
             self._state_sharding = None
-        self._step_jit = jax.jit(self._step)
+        # jit over a [:2] slice so the change flag is dead code inside
+        # the trace (the public step() discards it)
+        self._step_jit = jax.jit(
+            lambda sp, rp, masks: self._step(sp, rp, masks)[:2]
+        )
         self._step_sharded = None
         self._initial_jit = None
         self._observe_jit = None
         self._live_bits_jit = None
+        # donate the state buffers: every saturate() builds fresh arrays
+        # (initial_state / embed_state), and without donation XLA keeps a
+        # full input copy alive across the loop — 2x state memory
         if mesh is None:
-            self._run_jit = jax.jit(self._run, static_argnums=(3,))
+            self._run_jit = jax.jit(
+                self._run, static_argnums=(3,), donate_argnums=(0, 1)
+            )
         else:
             self._run_jit = functools.lru_cache(maxsize=4)(self._sharded_run)
 
@@ -362,17 +371,28 @@ class RowPackedSaturationEngine:
         rp: jax.Array,
         masks: Optional[Tuple[jax.Array, jax.Array]] = None,
         axis_name: Optional[str] = None,
-    ) -> Tuple[jax.Array, jax.Array]:
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """One superstep → (sp, rp, changed).  ``changed`` is tracked at
+        each rule's write (on the touched rows only) rather than by a
+        whole-array post-comparison, so the pre-step state is dead as
+        soon as the last rule reads it — without this the fixed-point
+        loop carries two full copies of S and OOMs ~2x earlier."""
         m4, m6 = self._masks if masks is None else masks
+        ch = jnp.asarray(False)
         # CR1: a ⊑ b
         for sl, plan in self._cr1_chunks:
-            sp = plan.apply(sp, sp[self._src1[sl]])
+            sp, c = plan.apply(sp, sp[self._src1[sl]], track=True)
+            ch |= c
         # CR2: a1 ⊓ a2 ⊑ b
         for sl, plan in self._cr2_chunks:
-            sp = plan.apply(sp, sp[self._src2a[sl]] & sp[self._src2b[sl]])
+            sp, c = plan.apply(
+                sp, sp[self._src2a[sl]] & sp[self._src2b[sl]], track=True
+            )
+            ch |= c
         # CR3: a ⊑ ∃link
         for sl, plan in self._cr3_chunks:
-            rp = plan.apply(rp, sp[self._src3[sl]])
+            rp, c = plan.apply(rp, sp[self._src3[sl]], track=True)
+            ch |= c
         # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
         # HBM end to end (the Pallas kernel unpacks/repacks per VMEM tile;
         # the XLA fallback materializes the wide operands instead)
@@ -380,13 +400,15 @@ class RowPackedSaturationEngine:
             for (sl, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
                 f4 = self._bit_table(sp, self._a4[sl], axis_name)  # [nl, ck]
                 w = m4[sl] * f4.T
-                sp = plan.apply(sp, mm(w, rp))
+                sp, c = plan.apply(sp, mm(w, rp), track=True)
+                ch |= c
         # CR6: role chains
         if self._p6 is not None:
             for (sl, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
                 f6 = self._bit_table(rp, self._l26[sl], axis_name)  # [nl, ck]
                 d = m6[sl] * f6.T
-                rp = plan.apply(rp, mm(d, rp))
+                rp, c = plan.apply(rp, mm(d, rp), track=True)
+                ch |= c
         # CR5: ⊥ back-propagation — one masked packed OR-reduce
         if self._bottom:
             botf = self._bit_table(sp, np.full(1, BOTTOM_ID), axis_name)
@@ -395,8 +417,11 @@ class RowPackedSaturationEngine:
             newrow = lax.reduce(
                 masked, np.uint32(0), lax.bitwise_or, (0,)
             )
-            sp = sp.at[BOTTOM_ID].set(sp[BOTTOM_ID] | newrow)
-        return sp, rp
+            old = sp[BOTTOM_ID]
+            merged = old | newrow
+            ch |= jnp.any(merged != old)
+            sp = sp.at[BOTTOM_ID].set(merged)
+        return sp, rp, ch
 
     def step(self, sp, rp):
         """One superstep.  On a mesh engine the matmul plans are sized to
@@ -409,7 +434,7 @@ class RowPackedSaturationEngine:
             axis = self.word_axis
             self._step_sharded = jax.jit(
                 jax.shard_map(
-                    lambda sp, rp, masks: self._step(sp, rp, masks, axis),
+                    lambda sp, rp, masks: self._step(sp, rp, masks, axis)[:2],
                     mesh=self.mesh,
                     in_specs=(
                         P(None, axis),
@@ -455,15 +480,15 @@ class RowPackedSaturationEngine:
 
         def body(st):
             sp, rp, it, _ = st
-            sp2, rp2 = sp, rp
+            changed = jnp.asarray(False)
             for _ in range(unroll):
-                sp2, rp2 = self._step(sp2, rp2, masks, axis_name)
-            changed = jnp.any(sp2 != sp) | jnp.any(rp2 != rp)
+                sp, rp, c = self._step(sp, rp, masks, axis_name)
+                changed |= c
             if axis_name is not None:
                 # the reference's global AND-vote
                 # (controller/CommunicationHandler.java:78-83) as one psum
                 changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
-            return (sp2, rp2, it + unroll, changed)
+            return (sp, rp, it + unroll, changed)
 
         init_bits = self._live_bits(sp0, rp0, axis_name)
         sp, rp, it, changed = lax.while_loop(
@@ -503,15 +528,16 @@ class RowPackedSaturationEngine:
                     P(axis),
                 ),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0, 1),
         )
 
     def _observe_round(self, sp, rp, masks):
-        sp2, rp2 = sp, rp
+        changed = jnp.asarray(False)
         for _ in range(self.unroll):
-            sp2, rp2 = self._step(sp2, rp2, masks)
-        changed = jnp.any(sp2 != sp) | jnp.any(rp2 != rp)
-        return sp2, rp2, changed, self._live_bits(sp2, rp2)
+            sp, rp, c = self._step(sp, rp, masks)
+            changed |= c
+        return sp, rp, changed, self._live_bits(sp, rp)
 
     def saturate_observed(
         self,

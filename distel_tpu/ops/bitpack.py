@@ -215,15 +215,23 @@ class SegmentedRowOr:
         _, v = lax.associative_scan(comb, (starts, rows), axis=0)
         return v[jnp.asarray(self._last)]
 
-    def apply(self, state, rows) -> jnp.ndarray:
+    def apply(self, state, rows, track: bool = False):
         """OR ``rows`` [K, W] (in ``order``) into ``state`` [N, W] at this
-        plan's target rows."""
+        plan's target rows.  ``track=True`` additionally returns a scalar
+        "did any bit change" — computed on the touched rows only, so the
+        caller never needs to keep the pre-step state alive for a
+        whole-array comparison (which doubles state memory inside the
+        fixed-point loop)."""
         if self.k == 0:
-            return state
+            return (state, jnp.asarray(False)) if track else state
         state = jnp.asarray(state)
         t = jnp.asarray(self.targets)
-        merged = state[t] | self.reduce(rows)
-        return state.at[t].set(merged)
+        old = state[t]
+        merged = old | self.reduce(rows)
+        out = state.at[t].set(merged)
+        if track:
+            return out, jnp.any(merged != old)
+        return out
 
     def split(self, max_rows: int):
         """Partition into subplans of at most ``max_rows`` source rows
