@@ -402,6 +402,51 @@ class Parser:
             ind = self._parse_individual()
             self._end()
             return S.ObjectSomeValuesFrom(role, S.ObjectOneOf((ind,)))
+        if name == "DataSomeValuesFrom":
+            # datatypes-as-classes (reference EntityType.DATATYPE,
+            # init/AxiomLoader.java:687-701): the data property acts as a
+            # role and a *named* datatype as a class; complex data ranges
+            # (DatatypeRestriction etc.) stay out of profile
+            role = self._parse_role()
+            tok = self.tz.peek()
+            if tok is not None and tok[0] in ("iri", "name"):
+                dt = self.tz.next()
+                nxt = self.tz.peek()
+                if nxt is not None and nxt[0] == "rpar":
+                    self._end()
+                    return S.ObjectSomeValuesFrom(
+                        role, self._as_class(self._resolve(dt[0], dt[1]))
+                    )
+            payload = self._consume_group_payload()
+            return S.UnsupportedClassExpression("DataSomeValuesFrom", payload)
+        if name == "DataHasValue":
+            # the reference keys DataHasValue on the *literal's datatype*
+            # (init/AxiomLoader.java:712-721): DataHasValue(p "v"^^dt) ≡
+            # ∃p.dt-as-class; untyped literals default to xsd:string
+            role = self._parse_role()
+            tok = self.tz.peek()
+            if tok is not None and tok[0] == "string":
+                self.tz.next()
+                dt_iri = "http://www.w3.org/2001/XMLSchema#string"
+                nxt = self.tz.peek()
+                if nxt is not None and nxt[0] == "lang":
+                    self.tz.next()
+                elif nxt is not None and nxt[0] == "caret":
+                    self.tz.next()
+                    dt_tok = self.tz.next()
+                    if dt_tok[0] not in ("iri", "name"):
+                        raise OWLParseError(
+                            f"expected datatype after ^^, got {dt_tok[1]!r}",
+                            dt_tok[2],
+                        )
+                    dt_iri = self._resolve(dt_tok[0], dt_tok[1])
+                if self.tz.peek() and self.tz.peek()[0] == "rpar":
+                    self._end()
+                    return S.ObjectSomeValuesFrom(
+                        role, self._as_class(dt_iri)
+                    )
+            payload = self._consume_group_payload()
+            return S.UnsupportedClassExpression("DataHasValue", payload)
         # out-of-profile constructor: swallow the group
         payload = self._consume_group_payload()
         return S.UnsupportedClassExpression(name, payload)

@@ -416,6 +416,55 @@ struct Parser {
         if (!expect(T_RPAR)) return -1;
         return id;
       }
+      if (name == "DataSomeValuesFrom") {
+        // datatypes-as-classes (reference EntityType.DATATYPE,
+        // init/AxiomLoader.java:687-701): data property as role, named
+        // datatype as class; complex data ranges stay out of profile
+        int32_t r = parse_role(); if (r < 0) return -1;
+        if (peek().kind == T_NAME || peek().kind == T_IRI) {
+          Tok dt = next();
+          if (peek().kind == T_RPAR) {
+            next();
+            Expr e;
+            e.kind = E_SOME;
+            e.role = r;
+            e.a = as_class(resolve(dt));
+            arena.push_back(std::move(e));
+            return (int32_t)arena.size() - 1;
+          }
+        }
+        if (!consume_group_open()) return -1;
+        return mk_expr(E_UNSUP);
+      }
+      if (name == "DataHasValue") {
+        // keyed on the literal's datatype (init/AxiomLoader.java:712-721);
+        // untyped literals default to xsd:string
+        int32_t r = parse_role(); if (r < 0) return -1;
+        if (peek().kind == T_STRING) {
+          next();
+          std::string dt_iri = "http://www.w3.org/2001/XMLSchema#string";
+          if (peek().kind == T_LANG) next();
+          else if (peek().kind == T_CARET) {
+            next();
+            Tok dt = next();
+            if (dt.kind != T_NAME && dt.kind != T_IRI) {
+              error = "expected datatype after ^^"; return -1;
+            }
+            dt_iri = resolve(dt);
+          }
+          if (peek().kind == T_RPAR) {
+            next();
+            Expr e;
+            e.kind = E_SOME;
+            e.role = r;
+            e.a = as_class(dt_iri);
+            arena.push_back(std::move(e));
+            return (int32_t)arena.size() - 1;
+          }
+        }
+        if (!consume_group_open()) return -1;
+        return mk_expr(E_UNSUP);
+      }
       // unsupported constructor: swallow group
       if (!consume_group_open()) return -1;
       return mk_expr(E_UNSUP);

@@ -102,6 +102,15 @@ CASES = [
     "SubClassOf(ObjectHasValue(owns felix) PetOwner)\n"
     "SubClassOf(ObjectSomeValuesFrom(owns ObjectOneOf(felix)) PetOwner2)\n"
     "SubClassOf(PetOwner Person)",
+    # datatypes-as-classes (reference EntityType.DATATYPE): named
+    # datatypes behave as classes, DataHasValue keys on the literal's
+    # datatype, complex data ranges drop out of profile
+    "SubClassOf(Person DataSomeValuesFrom(hasName xsd:string))\n"
+    "SubClassOf(DataSomeValuesFrom(hasName xsd:string) Named)\n"
+    'SubClassOf(Employee DataHasValue(hasId "123"^^xsd:integer))\n'
+    "SubClassOf(DataSomeValuesFrom(hasId xsd:integer) Identified)\n"
+    "SubClassOf(Doc DataSomeValuesFrom(len DatatypeRestriction(xsd:int "
+    'xsd:minInclusive "1"^^xsd:int)))',
 ]
 
 

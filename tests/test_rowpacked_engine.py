@@ -167,6 +167,23 @@ def test_classifier_rowpacked_engine():
     assert "CatDog" in res.taxonomy.unsatisfiable
 
 
+def test_rowpacked_random_ontologies_vs_oracle():
+    # randomized differential sweep — the strongest correctness net:
+    # arbitrary EL+ shapes (hierarchies, conjunctions, existentials,
+    # chains, disjointness) against the independent CPU oracle
+    import random
+
+    from test_engine_dense import _random_ontology
+
+    for seed in range(8):
+        rng = random.Random(seed * 17 + 3)
+        text = _random_ontology(rng)
+        norm, idx = _indexed(text)
+        result = RowPackedSaturationEngine(idx).saturate()
+        report = diff_engine_vs_oracle(norm, result)
+        assert report.ok(), f"seed {seed}:\n{report.summary()}\n{text}"
+
+
 # ----------------------------------------------------- mesh-sharded path
 
 
