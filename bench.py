@@ -32,6 +32,9 @@ from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
 def main() -> None:
     import jax
 
+    from distel_tpu.config import enable_compile_cache
+
+    enable_compile_cache()
     n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 8000
     text = synthetic_ontology(
         n_classes=n_classes,

@@ -22,9 +22,10 @@ import time
 
 
 def cmd_classify(args) -> int:
-    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.config import ClassifierConfig, enable_compile_cache
     from distel_tpu.runtime.classifier import ELClassifier
 
+    enable_compile_cache()
     cfg = (
         ClassifierConfig.from_properties(args.config)
         if args.config
@@ -52,10 +53,11 @@ def cmd_stream(args) -> int:
     delta file on top of the running closure (the reference's
     ``traffic-data-load-classify.sh`` loop; implied target there: avg
     ≤ 20 s per streamed file, ``output/analysis/StatsCollector.java``)."""
-    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.config import ClassifierConfig, enable_compile_cache
     from distel_tpu.core.incremental import IncrementalClassifier
     from distel_tpu.runtime.checkpoint import Snapshotter
 
+    enable_compile_cache()
     cfg = (
         ClassifierConfig.from_properties(args.config)
         if args.config
@@ -165,9 +167,10 @@ def cmd_bench(args) -> int:
     from distel_tpu.frontend.normalizer import normalize
     from distel_tpu.owl import loader as parser_compat
     from distel_tpu.core.indexing import index_ontology
-    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.config import ClassifierConfig, enable_compile_cache
     from distel_tpu.runtime.classifier import make_engine
 
+    enable_compile_cache()
     norm = normalize(parser_compat.load_file(args.ontology))
     idx = index_ontology(norm)
     engines = (

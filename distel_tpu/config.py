@@ -26,8 +26,28 @@ reference deployment's config can be carried over.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional
+
+
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (under ``~/.cache/distel_tpu``
+    unless the user set JAX_COMPILATION_CACHE_DIR) — repeat runs skip
+    the 10-100s jit compile of the saturation program.  Called by the
+    jax-using entry points (classify/stream/bench), never on import."""
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return
+    try:
+        import jax
+
+        cache = os.path.join(
+            os.path.expanduser("~"), ".cache", "distel_tpu", "jax-cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization, never a requirement
 
 
 @dataclass

@@ -81,6 +81,35 @@ class _Reader:
                 S.ObjectProperty(self._iri(children[0])),
                 S.ObjectOneOf((S.Individual(self._iri(children[1])),)),
             )
+        if loc == "DataSomeValuesFrom":
+            # datatypes-as-classes (init/AxiomLoader.java:687-701):
+            # named datatype as class; complex data ranges out of profile
+            children = list(el)
+            if len(children) == 2 and _local(children[1]) == "Datatype":
+                return S.ObjectSomeValuesFrom(
+                    S.ObjectProperty(self._iri(children[0])),
+                    S.Class(self._iri(children[1])),
+                )
+            return S.UnsupportedClassExpression(loc)
+        if loc == "DataHasValue":
+            # keyed on the literal's datatype (init/AxiomLoader.java:712-721)
+            children = list(el)
+            if len(children) == 2 and _local(children[1]) == "Literal":
+                lit = children[1]
+                dt = lit.get("datatypeIRI")
+                lang = lit.get(
+                    "{http://www.w3.org/XML/1998/namespace}lang"
+                )
+                if not dt:
+                    dt = (
+                        "http://www.w3.org/1999/02/22-rdf-syntax-ns#PlainLiteral"
+                        if lang
+                        else "http://www.w3.org/2001/XMLSchema#string"
+                    )
+                return S.ObjectSomeValuesFrom(
+                    S.ObjectProperty(self._iri(children[0])), S.Class(dt)
+                )
+            return S.UnsupportedClassExpression(loc)
         return S.UnsupportedClassExpression(loc)
 
     # ------------------------------------------------------------- axioms

@@ -422,7 +422,8 @@ class Parser:
         if name == "DataHasValue":
             # the reference keys DataHasValue on the *literal's datatype*
             # (init/AxiomLoader.java:712-721): DataHasValue(p "v"^^dt) ≡
-            # ∃p.dt-as-class; untyped literals default to xsd:string
+            # ∃p.dt-as-class; untyped literals are xsd:string (OWL 2
+            # structural spec), lang-tagged ones rdf:PlainLiteral
             role = self._parse_role()
             tok = self.tz.peek()
             if tok is not None and tok[0] == "string":
@@ -431,6 +432,9 @@ class Parser:
                 nxt = self.tz.peek()
                 if nxt is not None and nxt[0] == "lang":
                     self.tz.next()
+                    dt_iri = (
+                        "http://www.w3.org/1999/02/22-rdf-syntax-ns#PlainLiteral"
+                    )
                 elif nxt is not None and nxt[0] == "caret":
                     self.tz.next()
                     dt_tok = self.tz.next()

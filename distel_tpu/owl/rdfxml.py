@@ -114,9 +114,26 @@ def _parse_node(elem: ET.Element, store: _TripleStore, base: str) -> str:
                 store.add(subj, pred, _parse_node(child, store, base))
             continue
         text = (prop.text or "").strip()
-        # literal object — kept with a marker so it never collides with IRIs
-        store.add(subj, pred, f'"{text}"')
+        # literal object — quoted marker so it never collides with IRIs;
+        # rdf:datatype / xml:lang ride after the closing quote (consumers
+        # split on the LAST quote, so embedded quotes in text are safe)
+        dt = prop.get(f"{{{RDF}}}datatype")
+        lang = prop.get("{http://www.w3.org/XML/1998/namespace}lang")
+        suffix = f"^^{dt}" if dt else ("@" + lang if lang else "")
+        store.add(subj, pred, f'"{text}"{suffix}')
     return subj
+
+
+#: datatype IRI of a stored literal marker (OWL 2 mapping: untyped →
+#: xsd:string, lang-tagged → rdf:PlainLiteral) — the reference keys
+#: DataHasValue on this (init/AxiomLoader.java:712-721)
+def _literal_datatype(marker: str) -> str:
+    suffix = marker.rsplit('"', 1)[1]
+    if suffix.startswith("^^"):
+        return suffix[2:]
+    if suffix.startswith("@"):
+        return f"{RDF}PlainLiteral"
+    return "http://www.w3.org/2001/XMLSchema#string"
 
 
 class _AxiomBuilder:
@@ -169,16 +186,20 @@ class _AxiomBuilder:
                 tuple(S.Individual(m) for m in st.rdf_list(one_of))
             )
         has_value = st.one(node, f"{OWL}hasValue")
-        if (
-            on_prop is not None
-            and has_value is not None
-            and not has_value.startswith(('_:', '"'))  # not bnode/literal
-        ):
-            # EL sugar: hasValue restriction with an individual ≡ ∃r.{a}
-            return S.ObjectSomeValuesFrom(
-                S.ObjectProperty(on_prop),
-                S.ObjectOneOf((S.Individual(has_value),)),
-            )
+        if on_prop is not None and has_value is not None:
+            if has_value.startswith('"'):
+                # DataHasValue: keyed on the literal's datatype
+                # (datatypes-as-classes, init/AxiomLoader.java:712-721)
+                return S.ObjectSomeValuesFrom(
+                    S.ObjectProperty(on_prop),
+                    S.Class(_literal_datatype(has_value)),
+                )
+            if not has_value.startswith("_:"):
+                # EL sugar: hasValue with an individual ≡ ∃r.{a}
+                return S.ObjectSomeValuesFrom(
+                    S.ObjectProperty(on_prop),
+                    S.ObjectOneOf((S.Individual(has_value),)),
+                )
         for ctor in (
             "unionOf",
             "complementOf",

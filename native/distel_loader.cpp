@@ -443,7 +443,10 @@ struct Parser {
         if (peek().kind == T_STRING) {
           next();
           std::string dt_iri = "http://www.w3.org/2001/XMLSchema#string";
-          if (peek().kind == T_LANG) next();
+          if (peek().kind == T_LANG) {
+            next();
+            dt_iri = "http://www.w3.org/1999/02/22-rdf-syntax-ns#PlainLiteral";
+          }
           else if (peek().kind == T_CARET) {
             next();
             Tok dt = next();

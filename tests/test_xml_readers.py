@@ -177,7 +177,7 @@ def test_loader_dispatch_classifies():
 
 def test_rdfxml_has_value_restriction():
     # owl:hasValue with an individual ≡ ∃r.{a}; a literal-valued
-    # hasValue (DataHasValue) stays out of profile
+    # hasValue keys on the literal's datatype (datatypes-as-classes)
     text = """<?xml version="1.0"?>
 <rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#"
          xmlns:owl="http://www.w3.org/2002/07/owl#"
@@ -211,10 +211,54 @@ def test_rdfxml_has_value_restriction():
         and ax.sub.iri.endswith("Cat")
     ]
     somes = [s for s in sups if isinstance(s, S.ObjectSomeValuesFrom)]
-    assert len(somes) == 1
-    assert isinstance(somes[0].filler, S.ObjectOneOf)
-    assert somes[0].filler.individuals[0].iri.endswith("felix")
-    unsupported = [
-        s for s in sups if isinstance(s, S.UnsupportedClassExpression)
-    ]
-    assert len(unsupported) == 1
+    assert len(somes) == 2
+    nominals = [s for s in somes if isinstance(s.filler, S.ObjectOneOf)]
+    assert len(nominals) == 1
+    assert nominals[0].filler.individuals[0].iri.endswith("felix")
+    # untyped literal hasValue → ∃age.xsd:string (datatype-as-class)
+    dts = [s for s in somes if isinstance(s.filler, S.Class)]
+    assert len(dts) == 1 and dts[0].filler.iri.endswith("XMLSchema#string")
+
+
+def test_data_expressions_across_readers():
+    # datatypes-as-classes must agree across all four front-ends
+    from distel_tpu.owl import owlxml, rdfxml, syntax as S
+
+    def fillers(onto):
+        return {
+            getattr(ax.sup.filler, "iri", None)
+            for ax in onto.axioms
+            if isinstance(ax, S.SubClassOf)
+            and isinstance(ax.sup, S.ObjectSomeValuesFrom)
+        }
+
+    xsd_int = "http://www.w3.org/2001/XMLSchema#integer"
+    rx = rdfxml.parse(
+        '<?xml version="1.0"?>'
+        '<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#"'
+        ' xmlns:owl="http://www.w3.org/2002/07/owl#"'
+        ' xmlns:rdfs="http://www.w3.org/2000/01/rdf-schema#">'
+        '<owl:Class rdf:about="http://e/C"><rdfs:subClassOf>'
+        "<owl:Restriction>"
+        '<owl:onProperty rdf:resource="http://e/p"/>'
+        f'<owl:hasValue rdf:datatype="{xsd_int}">5</owl:hasValue>'
+        "</owl:Restriction></rdfs:subClassOf></owl:Class></rdf:RDF>"
+    )
+    assert xsd_int in fillers(rx)
+    ox = owlxml.parse(
+        '<?xml version="1.0"?>'
+        '<Ontology xmlns="http://www.w3.org/2002/07/owl#">'
+        '<SubClassOf><Class IRI="http://e/C">'
+        "</Class><DataHasValue>"
+        '<DataProperty IRI="http://e/p"/>'
+        f'<Literal datatypeIRI="{xsd_int}">5</Literal>'
+        "</DataHasValue></SubClassOf></Ontology>"
+    )
+    assert xsd_int in fillers(ox)
+    fs = parser.parse(f'SubClassOf(C DataHasValue(p "5"^^<{xsd_int}>))')
+    assert xsd_int in fillers(fs)
+    # lang-tagged literal → rdf:PlainLiteral everywhere
+    fs2 = parser.parse('SubClassOf(C DataHasValue(p "x"@en))')
+    assert any(
+        f and f.endswith("PlainLiteral") for f in fillers(fs2)
+    )
