@@ -346,6 +346,23 @@ class RowPackedSaturationEngine:
 
     # ------------------------------------------------------------- rules
 
+    def _shard_jit(self, fn, out_specs, donate=()):
+        """Shared shard_map+jit scaffolding for every mesh entry point
+        (fixed point, public step, observed round): state sharded on the
+        packed word axis, masks replicated."""
+        P = jax.sharding.PartitionSpec
+        state = P(None, self.word_axis)
+        return jax.jit(
+            jax.shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(state, state, (P(None, None), P(None, None))),
+                out_specs=out_specs,
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+
     def _bit_table(
         self, p: jax.Array, rows: np.ndarray, axis_name: Optional[str]
     ) -> jax.Array:
@@ -432,18 +449,9 @@ class RowPackedSaturationEngine:
         if self._step_sharded is None:
             P = jax.sharding.PartitionSpec
             axis = self.word_axis
-            self._step_sharded = jax.jit(
-                jax.shard_map(
-                    lambda sp, rp, masks: self._step(sp, rp, masks, axis)[:2],
-                    mesh=self.mesh,
-                    in_specs=(
-                        P(None, axis),
-                        P(None, axis),
-                        (P(None, None), P(None, None)),
-                    ),
-                    out_specs=(P(None, axis), P(None, axis)),
-                    check_vma=False,
-                )
+            self._step_sharded = self._shard_jit(
+                lambda sp, rp, masks: self._step(sp, rp, masks, axis)[:2],
+                out_specs=(P(None, axis), P(None, axis)),
             )
         return self._step_sharded(sp, rp, self._masks)
 
@@ -510,34 +518,27 @@ class RowPackedSaturationEngine:
             # construction); bits leave as per-shard partial sums
             return sp, rp, it[None], changed[None], bits, init_bits
 
-        return jax.jit(
-            jax.shard_map(
-                run,
-                mesh=self.mesh,
-                in_specs=(
-                    P(None, axis),
-                    P(None, axis),
-                    (P(None, None), P(None, None)),
-                ),
-                out_specs=(
-                    P(None, axis),
-                    P(None, axis),
-                    P(axis),
-                    P(axis),
-                    P(axis),
-                    P(axis),
-                ),
-                check_vma=False,
+        return self._shard_jit(
+            run,
+            out_specs=(
+                P(None, axis),
+                P(None, axis),
+                P(axis),
+                P(axis),
+                P(axis),
+                P(axis),
             ),
-            donate_argnums=(0, 1),
+            donate=(0, 1),
         )
 
-    def _observe_round(self, sp, rp, masks):
+    def _observe_round(self, sp, rp, masks, axis_name=None):
         changed = jnp.asarray(False)
         for _ in range(self.unroll):
-            sp, rp, c = self._step(sp, rp, masks)
+            sp, rp, c = self._step(sp, rp, masks, axis_name)
             changed |= c
-        return sp, rp, changed, self._live_bits(sp, rp)
+        if axis_name is not None:
+            changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
+        return sp, rp, changed, self._live_bits(sp, rp, axis_name)
 
     def saturate_observed(
         self,
@@ -552,16 +553,42 @@ class RowPackedSaturationEngine:
         by ``worksteal/ProgressMessageHandler.java`` and the timed
         completeness snapshots of ``misc/ResultSnapshotter.java``).  One
         host sync per superstep, so use :meth:`saturate` for benchmarks.
-        Single-device (on a mesh, run :meth:`saturate`)."""
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "observed mode is single-device; use saturate() on a mesh"
-            )
+        On a mesh each superstep runs in the same shard_map structure as
+        the fixed point."""
         if self._observe_jit is None:
             # old sp/rp are dead after each round — donate the buffers
-            self._observe_jit = jax.jit(
-                self._observe_round, donate_argnums=(0, 1)
-            )
+            if self.mesh is None:
+                self._observe_jit = jax.jit(
+                    self._observe_round, donate_argnums=(0, 1)
+                )
+            else:
+                P = jax.sharding.PartitionSpec
+                axis = self.word_axis
+
+                def fn(sp, rp, masks):
+                    sp, rp, ch, bits = self._observe_round(
+                        sp, rp, masks, axis
+                    )
+                    # scalar leaves as one lane per shard (replicated by
+                    # the psum); bits leave as per-shard partials
+                    return sp, rp, ch[None], bits
+
+                inner = self._shard_jit(
+                    fn,
+                    out_specs=(
+                        P(None, axis),
+                        P(None, axis),
+                        P(axis),
+                        P(axis),
+                    ),
+                    donate=(0, 1),
+                )
+
+                def observe(sp, rp, masks):
+                    sp, rp, lanes, bits = inner(sp, rp, masks)
+                    return sp, rp, lanes.max(), bits
+
+                self._observe_jit = observe
         if initial is None:
             sp, rp = self.initial_state()
         else:

@@ -101,11 +101,7 @@ class _Reader:
                     "{http://www.w3.org/XML/1998/namespace}lang"
                 )
                 if not dt:
-                    dt = (
-                        "http://www.w3.org/1999/02/22-rdf-syntax-ns#PlainLiteral"
-                        if lang
-                        else "http://www.w3.org/2001/XMLSchema#string"
-                    )
+                    dt = S.RDF_PLAIN_LITERAL if lang else S.XSD_STRING
                 return S.ObjectSomeValuesFrom(
                     S.ObjectProperty(self._iri(children[0])), S.Class(dt)
                 )

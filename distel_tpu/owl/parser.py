@@ -428,13 +428,11 @@ class Parser:
             tok = self.tz.peek()
             if tok is not None and tok[0] == "string":
                 self.tz.next()
-                dt_iri = "http://www.w3.org/2001/XMLSchema#string"
+                dt_iri = S.XSD_STRING
                 nxt = self.tz.peek()
                 if nxt is not None and nxt[0] == "lang":
                     self.tz.next()
-                    dt_iri = (
-                        "http://www.w3.org/1999/02/22-rdf-syntax-ns#PlainLiteral"
-                    )
+                    dt_iri = S.RDF_PLAIN_LITERAL
                 elif nxt is not None and nxt[0] == "caret":
                     self.tz.next()
                     dt_tok = self.tz.next()

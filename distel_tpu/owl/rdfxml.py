@@ -117,7 +117,7 @@ def _parse_node(elem: ET.Element, store: _TripleStore, base: str) -> str:
         # literal object — quoted marker so it never collides with IRIs;
         # rdf:datatype / xml:lang ride after the closing quote (consumers
         # split on the LAST quote, so embedded quotes in text are safe)
-        dt = prop.get(f"{{{RDF}}}datatype")
+        dt = prop.get(_DATATYPE)
         lang = prop.get("{http://www.w3.org/XML/1998/namespace}lang")
         suffix = f"^^{dt}" if dt else ("@" + lang if lang else "")
         store.add(subj, pred, f'"{text}"{suffix}')
@@ -132,8 +132,8 @@ def _literal_datatype(marker: str) -> str:
     if suffix.startswith("^^"):
         return suffix[2:]
     if suffix.startswith("@"):
-        return f"{RDF}PlainLiteral"
-    return "http://www.w3.org/2001/XMLSchema#string"
+        return S.RDF_PLAIN_LITERAL
+    return S.XSD_STRING
 
 
 class _AxiomBuilder:

@@ -49,6 +49,12 @@ class Class(ClassExpression):
 OWL_THING = Class("owl:Thing")
 OWL_NOTHING = Class("owl:Nothing")
 
+#: Literal-datatype IRIs shared by every reader (datatypes-as-classes,
+#: reference EntityType.DATATYPE): untyped literals are xsd:string per
+#: the OWL 2 structural spec, lang-tagged ones rdf:PlainLiteral.
+XSD_STRING = "http://www.w3.org/2001/XMLSchema#string"
+RDF_PLAIN_LITERAL = "http://www.w3.org/1999/02/22-rdf-syntax-ns#PlainLiteral"
+
 
 @dataclass(frozen=True)
 class Individual(ClassExpression):

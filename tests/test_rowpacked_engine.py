@@ -257,6 +257,22 @@ def test_rowpacked_packed_resume_matches_unpacked(small):
     assert (np.asarray(a.packed_s) == np.asarray(b.packed_s)).all()
 
 
+def test_sharded_rowpacked_observed(small, mesh8):
+    # observed mode on a mesh: same closure and derivation stream as the
+    # local observed run
+    norm, idx = small
+    local = RowPackedSaturationEngine(idx).saturate_observed()
+    events = []
+    sharded = RowPackedSaturationEngine(idx, mesh=mesh8).saturate_observed(
+        observer=lambda it, d, ch: events.append((it, d, ch))
+    )
+    assert sharded.derivations == local.derivations
+    n = idx.n_concepts
+    assert (sharded.s[:n, :n] == local.s[:n, :n]).all()
+    assert events and events[-1][1] == local.derivations
+    assert events[-1][2] is False  # converged
+
+
 def test_sharded_rowpacked_state_is_sharded(mesh8):
     norm, idx = _indexed(BOTTOM_ONTO)
     eng = RowPackedSaturationEngine(idx, mesh=mesh8)
