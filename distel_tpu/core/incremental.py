@@ -12,6 +12,13 @@ S/R is a *sound starting point* — we re-index with the persistent
 arrays, and run the fixed point again.  Iterations needed ≈ the depth of
 *new* consequences only, because everything old is already closed — the
 tensor-shaped analog of semi-naive delta evaluation.
+
+Known trade-off: each increment re-traces the saturation program,
+because the rule index tables are baked into the jaxpr as constants and
+any new axiom changes them (~a few seconds per increment; the
+persistent compile cache only helps identical corpora).  Making the
+tables traced arguments padded to stable buckets would amortize this —
+deferred until streaming latency matters more than code simplicity.
 """
 
 from __future__ import annotations
