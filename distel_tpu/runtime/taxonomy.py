@@ -11,18 +11,21 @@ Two paths:
 
 * **device** (default when the saturation result is device-resident):
   the projection (a bit lookup over the packed closure), the mutual-
-  subsumption split, and the transitive reduction (one AND-OR semiring
-  matmul on the MXU) all run on the accelerator; only compact arrays
+  subsumption split, and the transitive reduction (AND-OR semiring
+  matmuls on the MXU) all run on the accelerator; only compact arrays
   cross to the host — canonical-representative ids, the unsat mask, and
   each class's direct parents (top-k indices, ``_PARENT_CAP`` wide).
   On a remote-attached chip this replaces a multi-second bulk transfer
-  of the closure with <5 MB.  The full ``subsumers`` dict — which is
-  output-sized — is reconstructed lazily on the host by walking the
-  reduced DAG, only if someone reads it.
-* **host**: the original numpy implementation, used as fallback for very
-  large signatures (where the dense [n, n] projection would not fit on
-  device), for parent counts beyond ``_PARENT_CAP``, and as the
-  reference in tests.
+  of the closure with <5 MB.  Two device programs: a simple dense one
+  up to ``_DEVICE_N_CAP`` (24k) classes, and a **blocked bit-packed**
+  one beyond it (projection held as [n, n/32] uint32, processed in
+  ``_TAX_BLOCK``-row blocks through the packed-columns Pallas matmul)
+  up to ``_DEVICE_BLOCKED_N_CAP`` (120k).  The full ``subsumers`` dict
+  — which is output-sized — is reconstructed lazily on the host by
+  walking the reduced DAG, only if someone reads it.
+* **host**: the original numpy implementation, used as fallback past
+  the blocked cap, for parent counts beyond ``_PARENT_CAP``, and as
+  the reference in tests.
 """
 
 from __future__ import annotations
@@ -38,11 +41,17 @@ from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID
 #: max direct parents per class the device path transfers; beyond this it
 #: falls back to the host path (ELK-style taxonomies are far shallower)
 _PARENT_CAP = 64
-#: signature size beyond which the dense [n, n] device projection is
-#: skipped: peak HBM ≈ 10·n² bytes (two int32 [n, n] temporaries — the
-#: reduction matmul output and the tie-broken top-k operand — plus the
-#: live bool/int8 squares), so 24k ≈ 6 GB
+#: signature size up to which the simple dense device program is used:
+#: peak HBM ≈ 10·n² bytes (two int32 [n, n] temporaries — the reduction
+#: matmul output and the tie-broken top-k operand — plus the live
+#: bool/int8 squares), so 24k ≈ 6 GB.  Beyond it the *blocked packed*
+#: device program takes over (peak ≈ 4·n²/8 + block temporaries).
 _DEVICE_N_CAP = 24_000
+#: signature size beyond which even the blocked packed device program is
+#: skipped (≈ n²/2 bytes packed state)
+_DEVICE_BLOCKED_N_CAP = 120_000
+#: row-block size of the blocked device program
+_TAX_BLOCK = 4096
 
 
 class Taxonomy:
@@ -157,9 +166,12 @@ def extract_taxonomy(
         return Taxonomy({}, {}, {}, [])
     if method == "host":
         return _extract_host(result, orig, names)
-    if method == "auto" and len(orig) > _DEVICE_N_CAP:
+    if method == "auto" and len(orig) > _DEVICE_BLOCKED_N_CAP:
         return _extract_host(result, orig, names)
-    got = _extract_device(result, orig, names)
+    if len(orig) > _DEVICE_N_CAP:
+        got = _extract_device_blocked(result, orig, names)
+    else:
+        got = _extract_device(result, orig, names)
     if got is None:  # parent-cap overflow
         if method == "device":
             raise ValueError(
@@ -215,16 +227,10 @@ def _device_program(orig_bytes: bytes, transposed: bool, cap: int):
     return jax.jit(run)
 
 
-def _extract_device(result, orig, names) -> Optional[Taxonomy]:
-    import jax
-
+def _assemble(orig, names, canon, unsat, counts, pidx) -> Optional[Taxonomy]:
+    """Host assembly of the compact device outputs (shared by the dense
+    and blocked device programs).  None on parent-cap overflow."""
     n = len(orig)
-    run = _device_program(
-        np.asarray(orig, np.int64).tobytes(),
-        bool(result.transposed),
-        _PARENT_CAP,
-    )
-    canon, unsat, counts, pidx = jax.device_get(run(result.packed_s))
     if counts.max(initial=0) > _PARENT_CAP:
         return None
     unsat_names = sorted(names[i] for i in np.nonzero(unsat)[0])
@@ -246,6 +252,158 @@ def _extract_device(result, orig, names) -> Optional[Taxonomy]:
         ps = pidx[k, : counts[k]]
         parents[names[i]] = sorted(names[j] for j in ps)
     return Taxonomy(None, equivalents, parents, unsat_names)
+
+
+def _extract_device(result, orig, names) -> Optional[Taxonomy]:
+    import jax
+
+    run = _device_program(
+        np.asarray(orig, np.int64).tobytes(),
+        bool(result.transposed),
+        _PARENT_CAP,
+    )
+    canon, unsat, counts, pidx = jax.device_get(run(result.packed_s))
+    return _assemble(orig, names, canon, unsat, counts, pidx)
+
+
+# ----------------------------------------------- blocked device path (big n)
+
+
+@functools.lru_cache(maxsize=4)
+def _device_blocked_program(
+    orig_bytes: bytes, transposed: bool, cap: int, block: int
+):
+    """Taxonomy reduction for signatures past the dense device cap: the
+    projected subsumption matrix lives **bit-packed** on device
+    ([n, n/32] uint32, rows = first index, bits = second), built and
+    consumed in row blocks, with the transitive-reduction matmul running
+    on the packed-columns Pallas kernel.  eq is symmetric, so one packed
+    array serves both orientations; everything is derived in the
+    "rows i, bits j" orientation whose rows are per-class parent sets.
+    Peak HBM ≈ 4 packed squares (n²/2 bytes) + [block, n] temporaries."""
+    import jax
+    import jax.numpy as jnp
+
+    from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
+    from distel_tpu.ops.bitpack import (
+        bit_lookup,
+        pack_bool_columns,
+        unpack_words,
+    )
+
+    o = np.frombuffer(orig_bytes, np.int64)
+    n = len(o)
+    npad = ((n + 31) // 32) * 32
+    nw = npad // 32
+    blocks = [(i, min(i + block, n)) for i in range(0, n, block)]
+    mm = PackedColsMatmulPlan(block, npad, nw)
+
+    def run(packed_s):
+        # sub[i, j] ⇔ orig_i ⊑ orig_j.  Two packed forms are built block
+        # by block with bit_lookup (out[c, r] = bit(p[rows_r], cols_c)):
+        #   subt  rows i, bits j  (row = a class's parent set)
+        #   subp  rows j, bits i  (the mirror, for the symmetry AND)
+        # transposed result: bit(p[a], x) = sub[x, a];
+        # x-major result:    bit(p[x], a) = sub[x, a].
+        if transposed:
+            unsat = bit_lookup(
+                packed_s, rows=np.full(1, BOTTOM_ID), cols=o
+            )[:, 0]
+        else:
+            unsat = bit_lookup(
+                packed_s, rows=o, cols=np.full(1, BOTTOM_ID)
+            )[0]
+        unsat = jnp.asarray(unsat, bool)
+        unsat_packed = pack_bool_columns(
+            jnp.pad(unsat, (0, npad - n))[None, :]
+        )[0]
+
+        def oriented_block(lo, hi, want_rows_i):
+            """bool [hi-lo, npad]: rows over the block of the wanted row
+            index, bits over the full other index."""
+            if transposed == want_rows_i:
+                # block indexes bit_lookup's cols → rows already oriented
+                blk = bit_lookup(packed_s, rows=o, cols=o[lo:hi])
+            else:
+                blk = bit_lookup(packed_s, rows=o[lo:hi], cols=o).T
+            return jnp.pad(blk, ((0, 0), (0, npad - n)))
+
+        subt_rows, subp_rows = [], []
+        for lo, hi in blocks:
+            ii = jnp.arange(hi - lo)
+            # rows i: unsat rows are ⊑ everything; reflexive diagonal
+            bt = oriented_block(lo, hi, want_rows_i=True)
+            bt = bt | unsat[lo:hi, None]
+            bt = bt.at[ii, jnp.arange(lo, hi)].set(True)
+            subt_rows.append(pack_bool_columns(bt))
+            # rows j: unsat bit-columns set in every row; diagonal
+            bp = oriented_block(lo, hi, want_rows_i=False)
+            bp = bp.at[ii, jnp.arange(lo, hi)].set(True)
+            subp_rows.append(pack_bool_columns(bp) | unsat_packed[None, :])
+        subt = jnp.pad(
+            jnp.concatenate(subt_rows, axis=0), ((0, npad - n), (0, 0))
+        )
+        subp = jnp.pad(
+            jnp.concatenate(subp_rows, axis=0), ((0, npad - n), (0, 0))
+        )
+
+        eq = subt & subp            # symmetric: serves both orientations
+        strict_t = subt & ~eq       # rows i, bits j
+
+        # canon[i] = smallest j with eq[i, j] (argmax of row i)
+        canons = []
+        for lo, hi in blocks:
+            bits = unpack_words(eq[lo:hi], npad, jnp.int8)
+            canons.append(jnp.argmax(bits, axis=1).astype(jnp.int32))
+        canon = jnp.concatenate(canons)[:n]
+
+        is_rep = (canon == jnp.arange(n)) & ~unsat
+        repmask = pack_bool_columns(
+            jnp.pad(is_rep, (0, npad - n))[None, :]
+        )[0]
+        strict_r = jnp.where(
+            jnp.pad(is_rep, (0, npad - n))[:, None],
+            strict_t & repmask[None, :],
+            jnp.asarray(0, jnp.uint32),
+        )
+
+        # transitive reduction: indirect[i, j] = ∃q strict[i,q] ∧ strict[q,j]
+        # = (unpack(strict_r rows i over q) ⊙ strict_r) on the MXU
+        counts = []
+        pidx = []
+        for lo, hi in blocks:
+            a = unpack_words(strict_r[lo:hi], npad, jnp.int8)
+            a = jnp.pad(a, ((0, block - (hi - lo)), (0, 0)))
+            indirect = mm(a, strict_r)[: hi - lo]        # [blk, nw] packed
+            direct = strict_r[lo:hi] & ~indirect
+            bits = unpack_words(direct, npad, jnp.int8)[:, :n]
+            counts.append(jnp.sum(bits, axis=1, dtype=jnp.int32))
+            scored = jnp.where(
+                bits.astype(bool), jnp.arange(n, 0, -1, dtype=jnp.int32), 0
+            )
+            _, top = jax.lax.top_k(scored, min(cap, n))
+            pidx.append(top.astype(jnp.int32))
+        return (
+            canon,
+            unsat,
+            jnp.concatenate(counts)[:n],
+            jnp.concatenate(pidx)[:n],
+        )
+
+    return jax.jit(run)
+
+
+def _extract_device_blocked(result, orig, names) -> Optional[Taxonomy]:
+    import jax
+
+    run = _device_blocked_program(
+        np.asarray(orig, np.int64).tobytes(),
+        bool(result.transposed),
+        _PARENT_CAP,
+        _TAX_BLOCK,
+    )
+    canon, unsat, counts, pidx = jax.device_get(run(result.packed_s))
+    return _assemble(orig, names, canon, unsat, counts, pidx)
 
 
 # --------------------------------------------------------------- host path

@@ -82,6 +82,38 @@ def test_taxonomy_device_matches_host():
             assert dev.subsumers == host.subsumers
 
 
+def test_taxonomy_blocked_device_matches_host(monkeypatch):
+    # the blocked packed device program (used past the dense device cap)
+    # must agree with the host path — forced multi-block via a tiny block
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.core.engine import SaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import synthetic_ontology
+    from distel_tpu.owl import parser
+    from distel_tpu.runtime import taxonomy as T
+
+    monkeypatch.setattr(T, "_TAX_BLOCK", 64)
+    T._device_blocked_program.cache_clear()
+    for corpus in (
+        ONTO,
+        synthetic_ontology(
+            n_classes=300, n_anatomy=45, n_locations=30, n_definitions=25
+        ),
+    ):
+        idx = index_ontology(normalize(parser.parse(corpus)))
+        for engine in (RowPackedSaturationEngine(idx), SaturationEngine(idx)):
+            result = engine.saturate()
+            orig, names = T._signature(result.idx)
+            dev = T._extract_device_blocked(result, orig, names)
+            host = T._extract_host(result, orig, names)
+            assert dev is not None
+            assert dev.unsatisfiable == host.unsatisfiable
+            assert dev.parents == host.parents
+            assert dev.equivalents == host.equivalents
+    T._device_blocked_program.cache_clear()
+
+
 def test_taxonomy_write_roundtrip(classified, tmp_path):
     p = tmp_path / "taxonomy.ofn"
     classified.taxonomy.write(str(p))
