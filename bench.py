@@ -29,13 +29,22 @@ from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine  # noqa: 
 from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
 
 
+def _timed(f) -> float:
+    t0 = time.time()
+    f()
+    return time.time() - t0
+
+
 def main() -> None:
     import jax
 
     from distel_tpu.config import enable_compile_cache
 
     enable_compile_cache()
-    n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 8000
+    # 16k is the measured throughput sweet spot on one v5e core: small
+    # enough that the CPU-baseline run stays in budget, large enough that
+    # compute dominates the ~117 ms tunnel round-trip of a warm call
+    n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
     text = synthetic_ontology(
         n_classes=n_classes,
         n_anatomy=max(200, n_classes // 10),
@@ -46,13 +55,15 @@ def main() -> None:
     idx = index_ontology(norm)
 
     engine = RowPackedSaturationEngine(idx)
-    # cold run = compile + execute; warm run is the steady-state number
+    # cold run = compile + execute; warm = best of 3 steady-state runs
+    # (each warm call pays one host->device round trip, which is noisy
+    # over the remote tunnel)
     t0 = time.time()
     result = engine.saturate()
     cold_s = time.time() - t0
-    t0 = time.time()
-    result = engine.saturate()
-    warm_s = time.time() - t0
+    warm_s = min(
+        _timed(engine.saturate) for _ in range(3)
+    )
     engine_dps = result.derivations / warm_s
 
     # CPU reference baseline on the same corpus

@@ -20,9 +20,9 @@ So this engine stores the state **transposed and packed**:
 reference's storage was row-packed-shaped all along).  Every completion
 rule now *writes whole rows*, and every row write becomes:
 
-  gather source rows → segmented OR over same-target runs
-  (``ops/bitpack.SegmentedRowOr``: one ``associative_scan``) →
-  scatter-*set* at the distinct target rows
+  gather source rows → bucketed segmented OR
+  (``ops/bitpack.SegmentedRowOr``: reshape + OR-reduce per
+  power-of-two length bucket) → scatter-*set* at the distinct target rows
 
 which XLA lowers to dense fast ops — no scatter-max anywhere.  Measured
 on a v5e: CR1 at 20k concepts drops 34 ms → 1.3 ms.
@@ -147,31 +147,38 @@ class RowPackedSaturationEngine:
             fillers[: idx.n_links] = idx.links[:, 1]
         self._fillers = fillers
 
-        # CR4: rows of the [K4, L] operand in seg-OR target order.  The
-        # closure masks are device arrays passed as *arguments* to the
-        # jitted run — embedded as program constants they get serialized
-        # into every (remote) compile request, which breaks past ~100 MB.
+        # CR4/CR6: the seg-OR emission order is repeat-padded
+        # (SegmentedRowOr buckets), but repeats on the *matmul* path would
+        # be real redundant MXU work — so the matmul runs over the chunk's
+        # unique raw axioms and its packed output is expanded into padded
+        # emission order by a cheap row gather (``inv``) before the
+        # seg-OR.  The closure masks are device arrays passed as
+        # *arguments* to the jitted run — embedded as program constants
+        # they get serialized into every (remote) compile request, which
+        # breaks past ~100 MB.
         self._p4 = None
         m4 = np.zeros((0, 0), np.int8)
         if len(idx.nf4) and idx.n_links and on("CR4"):
             self._p4 = SegmentedRowOr(idx.nf4[:, 2])
-            nf4o = idx.nf4[self._p4.order]
-            self._a4 = nf4o[:, 1]
             # m4[j, l] = H[role(l), s_j] — the link's role must be a
             # (transitive) subrole of the axiom's s
-            m4 = np.zeros((len(nf4o), self.nl), np.int8)
-            m4[:, : idx.n_links] = h.T[nf4o[:, 0]][:, link_roles].astype(np.int8)
+            m4 = np.zeros((len(idx.nf4), self.nl), np.int8)
+            m4[:, : idx.n_links] = h.T[idx.nf4[:, 0]][:, link_roles].astype(
+                np.int8
+            )
+            self._a4 = idx.nf4[:, 1]
 
         # CR6: chain second legs, same layout
         self._p6 = None
         m6 = np.zeros((0, 0), np.int8)
         if len(idx.chain_pairs) and idx.n_links and on("CR6"):
             self._p6 = SegmentedRowOr(idx.chain_pairs[:, 2])
-            cpo = idx.chain_pairs[self._p6.order]
-            self._l26 = cpo[:, 1]
             # m6[p, l] = H[role(l), r_p] — first-leg subrole closure
-            m6 = np.zeros((len(cpo), self.nl), np.int8)
-            m6[:, : idx.n_links] = h.T[cpo[:, 0]][:, link_roles].astype(np.int8)
+            m6 = np.zeros((len(idx.chain_pairs), self.nl), np.int8)
+            m6[:, : idx.n_links] = h.T[idx.chain_pairs[:, 0]][
+                :, link_roles
+            ].astype(np.int8)
+            self._l26 = idx.chain_pairs[:, 1]
         self._masks = (jnp.asarray(m4), jnp.asarray(m6))
 
         self._bottom = bool(
@@ -196,8 +203,19 @@ class RowPackedSaturationEngine:
         self._cr1_chunks = self._p1.split(gather_rows)
         self._cr2_chunks = self._p2.split(gather_rows // 2)
         self._cr3_chunks = self._p3.split(gather_rows)
-        self._cr4_chunks = self._p4.split(mm_rows) if self._p4 else []
-        self._cr6_chunks = self._p6.split(mm_rows) if self._p6 else []
+
+        def mm_chunks(plan):
+            """[(raw_ids, inv, piece)]: the matmul runs over the chunk's
+            unique raw axioms; ``raw_ids[inv]`` restores the seg-OR's
+            repeat-padded emission order."""
+            out = []
+            for sl, piece in plan.split(mm_rows) if plan else []:
+                raw_ids, inv = np.unique(plan.order[sl], return_inverse=True)
+                out.append((raw_ids, inv, piece))
+            return out
+
+        self._cr4_chunks = mm_chunks(self._p4)
+        self._cr6_chunks = mm_chunks(self._p6)
         # one packed-output matmul plan per chunk (shard-local width).
         # dtype: forwarded only when the caller pinned one — the Pallas
         # kernel's own default (bf16 on TPU) wins otherwise; the engine's
@@ -207,12 +225,12 @@ class RowPackedSaturationEngine:
             mm_kw["dtype"] = matmul_dtype
         wl = self.wc // self.n_shards
         self._cr4_mm = [
-            PackedColsMatmulPlan(sl.stop - sl.start, self.nl, wl, **mm_kw)
-            for sl, _ in self._cr4_chunks
+            PackedColsMatmulPlan(len(raw), self.nl, wl, **mm_kw)
+            for raw, _, _ in self._cr4_chunks
         ]
         self._cr6_mm = [
-            PackedColsMatmulPlan(sl.stop - sl.start, self.nl, wl, **mm_kw)
-            for sl, _ in self._cr6_chunks
+            PackedColsMatmulPlan(len(raw), self.nl, wl, **mm_kw)
+            for raw, _, _ in self._cr6_chunks
         ]
 
         # live-column word mask: bits for x < n_concepts only
@@ -412,19 +430,22 @@ class RowPackedSaturationEngine:
             ch |= c
         # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
         # HBM end to end (the Pallas kernel unpacks/repacks per VMEM tile;
-        # the XLA fallback materializes the wide operands instead)
+        # the XLA fallback materializes the wide operands instead).  The
+        # matmul contracts over the chunk's unique raw axioms; its packed
+        # output rows are then gathered into the seg-OR's repeat-padded
+        # emission order (packed-row copies are ~free next to MXU work)
         if self._p4 is not None:
-            for (sl, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
-                f4 = self._bit_table(sp, self._a4[sl], axis_name)  # [nl, ck]
-                w = m4[sl] * f4.T
-                sp, c = plan.apply(sp, mm(w, rp), track=True)
+            for (raw, inv, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
+                f4 = self._bit_table(sp, self._a4[raw], axis_name)  # [nl, rk]
+                w = m4[raw] * f4.T
+                sp, c = plan.apply(sp, mm(w, rp)[inv], track=True)
                 ch |= c
         # CR6: role chains
         if self._p6 is not None:
-            for (sl, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
-                f6 = self._bit_table(rp, self._l26[sl], axis_name)  # [nl, ck]
-                d = m6[sl] * f6.T
-                rp, c = plan.apply(rp, mm(d, rp), track=True)
+            for (raw, inv, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
+                f6 = self._bit_table(rp, self._l26[raw], axis_name)  # [nl, rk]
+                d = m6[raw] * f6.T
+                rp, c = plan.apply(rp, mm(d, rp)[inv], track=True)
                 ch |= c
         # CR5: ⊥ back-propagation — one masked packed OR-reduce
         if self._bottom:
