@@ -98,10 +98,14 @@ class RowPackedSaturationEngine:
         temp_budget_bytes: int = 1 << 29,
         use_pallas: Optional[bool] = None,
         rules: Optional[frozenset] = None,
+        mm_opts: Optional[dict] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
-        another backend (``core/hybrid.py``) are excluded here."""
+        another backend (``core/hybrid.py``) are excluded here.
+        ``mm_opts``: extra keyword overrides for the CR4/CR6
+        :class:`PackedColsMatmulPlan` (tiling, ``skip_zero_tiles``,
+        ``interpret``) — the test hook for pinning a kernel variant."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
@@ -223,6 +227,8 @@ class RowPackedSaturationEngine:
         mm_kw = {"use_xla": not use_pallas}
         if matmul_dtype is not None:
             mm_kw["dtype"] = matmul_dtype
+        if mm_opts:
+            mm_kw.update(mm_opts)
         wl = self.wc // self.n_shards
         self._cr4_mm = [
             PackedColsMatmulPlan(len(raw), self.nl, wl, **mm_kw)

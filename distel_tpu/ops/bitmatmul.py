@@ -197,17 +197,13 @@ class PackedMatmulPlan:
         return (prod > 0).astype(jnp.int8)[: self.m, : self.n]
 
 
-def _packed_cols_kernel(a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int):
-    """Grid (i, j, k), k innermost; acc [TM, 32*TW] f32 persists across k.
-    B tiles are packed uint32 words; unpack/repack happen entirely in
-    VMEM, bit-plane-major via lane-aligned static slices (no sub-lane
-    reshapes, which blow up Mosaic lowering)."""
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
+def _packed_cols_prologue(acc_ref):
+    @pl.when(pl.program_id(2) == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+
+def _packed_cols_accumulate(a_ref, b_ref, acc_ref, dtype):
     one = jnp.asarray(1, jnp.uint32)
     b = b_ref[:]                                        # [TL, TW] uint32
     bits = jnp.concatenate(
@@ -220,13 +216,45 @@ def _packed_cols_kernel(a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int):
     a = a_ref[:].astype(jnp.int32).astype(dtype)        # [TM, TL]
     acc_ref[:] += jnp.dot(a, bits, preferred_element_type=jnp.float32)
 
-    @pl.when(k == pl.num_programs(2) - 1)
+
+def _packed_cols_epilogue(o_ref, acc_ref, tw: int):
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _():
         hit = acc_ref[:] > 0                            # [TM, 32*TW]
         word = jnp.zeros(o_ref.shape, jnp.uint32)
         for p in range(32):
             word |= hit[:, p * tw : (p + 1) * tw].astype(jnp.uint32) << p
         o_ref[:] = word
+
+
+def _packed_cols_kernel(a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int):
+    """Grid (i, j, k), k innermost; acc [TM, 32*TW] f32 persists across k.
+    B tiles are packed uint32 words; unpack/repack happen entirely in
+    VMEM, bit-plane-major via lane-aligned static slices (no sub-lane
+    reshapes, which blow up Mosaic lowering)."""
+    _packed_cols_prologue(acc_ref)
+    _packed_cols_accumulate(a_ref, b_ref, acc_ref, dtype)
+    _packed_cols_epilogue(o_ref, acc_ref, tw)
+
+
+def _packed_cols_sparse_kernel(
+    flags_ref, a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int
+):
+    """Tile-skipping variant of :func:`_packed_cols_kernel`.
+    ``flags_ref`` (scalar-prefetch, [GM, GK] int32) marks which A tiles
+    contain any nonzero: the unpack + MXU dot are skipped for all-zero A
+    tiles.  The per-step operand A = closure-mask ∧ bit-table is ~99.9%
+    sparse at saturation scale (measured 0.1% dense *at the fixed
+    point*, emptier in every earlier iteration), so most of the grid
+    skips — the matmuls are compute-bound, and the skipped dot is the
+    cost."""
+    _packed_cols_prologue(acc_ref)
+
+    @pl.when(flags_ref[pl.program_id(0), pl.program_id(2)] != 0)
+    def _():
+        _packed_cols_accumulate(a_ref, b_ref, acc_ref, dtype)
+
+    _packed_cols_epilogue(o_ref, acc_ref, tw)
 
 
 class PackedColsMatmulPlan:
@@ -261,6 +289,7 @@ class PackedColsMatmulPlan:
         dtype=None,
         interpret: bool = False,
         use_xla: Optional[bool] = None,
+        skip_zero_tiles: Optional[bool] = None,
     ):
         self.m, self.l, self.w = m, l, w
         self.tm, self.tl, self.tw = tm, tl, tw
@@ -275,6 +304,12 @@ class PackedColsMatmulPlan:
         if use_xla is None:
             use_xla = jax.default_backend() != "tpu" and not interpret
         self.use_xla = use_xla
+        if skip_zero_tiles is None:
+            # the per-tile branch costs pipeline overlap on dense tiles;
+            # it pays only once the full contraction is MXU-bound
+            # (measured crossover ~1 TFLOP on a v5e)
+            skip_zero_tiles = 2 * self.m_p * self.l_p * self.w_p * 32 >= 1e12
+        self.skip_zero_tiles = skip_zero_tiles
         if not use_xla and jnp.issubdtype(dtype, jnp.integer):
             # Mosaic's MXU path requires float operands with the f32
             # accumulator; bf16 is exact here (0/1 products, < 2^24 terms)
@@ -293,32 +328,67 @@ class PackedColsMatmulPlan:
             b_packed,
             ((0, self.l_p - b_packed.shape[0]), (0, self.w_p - b_packed.shape[1])),
         )
+        gm = self.m_p // self.tm
+        gk = self.l_p // self.tl
+        grid = (gm, self.w_p // self.tw, gk)
+        a_spec = ((self.tm, self.tl), lambda i, j, k: (i, k))
+        b_spec = ((self.tl, self.tw), lambda i, j, k: (k, j))
+        o_spec = ((self.tm, self.tw), lambda i, j, k: (i, j))
+        scratch = [pltpu.VMEM((self.tm, 32 * self.tw), jnp.float32)]
+        out_shape = jax.ShapeDtypeStruct((self.m_p, self.w_p), jnp.uint32)
+        if not self.skip_zero_tiles:
+            out = pl.pallas_call(
+                functools.partial(
+                    _packed_cols_kernel, dtype=self.dtype, tw=self.tw
+                ),
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec(*a_spec, memory_space=pltpu.VMEM),
+                    pl.BlockSpec(*b_spec, memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec(*o_spec, memory_space=pltpu.VMEM),
+                out_shape=out_shape,
+                scratch_shapes=scratch,
+                interpret=self.interpret,
+            )(a, b)
+            return out[: self.m, : self.w]
+        # per-A-tile any-nonzero flags, computed by XLA in one cheap pass;
+        # index maps gain a trailing scalar-prefetch ref argument
+        flags = (
+            (a != 0)
+            .reshape(gm, self.tm, gk, self.tl)
+            .any(axis=(1, 3))
+            .astype(jnp.int32)
+        )
         out = pl.pallas_call(
             functools.partial(
-                _packed_cols_kernel, dtype=self.dtype, tw=self.tw
+                _packed_cols_sparse_kernel, dtype=self.dtype, tw=self.tw
             ),
-            grid=(self.m_p // self.tm, self.w_p // self.tw, self.l_p // self.tl),
-            in_specs=[
-                pl.BlockSpec(
-                    (self.tm, self.tl),
-                    lambda i, j, k: (i, k),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec(
+                        a_spec[0],
+                        lambda i, j, k, f: (i, k),
+                        memory_space=pltpu.VMEM,
+                    ),
+                    pl.BlockSpec(
+                        b_spec[0],
+                        lambda i, j, k, f: (k, j),
+                        memory_space=pltpu.VMEM,
+                    ),
+                ],
+                out_specs=pl.BlockSpec(
+                    o_spec[0],
+                    lambda i, j, k, f: (i, j),
                     memory_space=pltpu.VMEM,
                 ),
-                pl.BlockSpec(
-                    (self.tl, self.tw),
-                    lambda i, j, k: (k, j),
-                    memory_space=pltpu.VMEM,
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (self.tm, self.tw),
-                lambda i, j, k: (i, j),
-                memory_space=pltpu.VMEM,
+                scratch_shapes=scratch,
             ),
-            out_shape=jax.ShapeDtypeStruct((self.m_p, self.w_p), jnp.uint32),
-            scratch_shapes=[pltpu.VMEM((self.tm, 32 * self.tw), jnp.float32)],
+            out_shape=out_shape,
             interpret=self.interpret,
-        )(a, b)
+        )(flags, a, b)
         return out[: self.m, : self.w]
 
     def _xla(self, a: jax.Array, b_packed: jax.Array) -> jax.Array:

@@ -102,20 +102,24 @@ def test_packed_matmul_plan_kernel_order():
     assert (c.astype(bool) == c_ref).all()
 
 
-@pytest.mark.parametrize("mode", ["xla", "interpret"])
+@pytest.mark.parametrize("mode", ["xla", "interpret", "interpret-sparse"])
 def test_packed_cols_matmul(mode):
     from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
 
     M, L, X = 37, 70, 130          # deliberately unaligned everywhere
     w = (X + 31) // 32
     a = rng.random((M, L)) < 0.2
+    if mode == "interpret-sparse":
+        # zero out most tiles so the skip path actually skips
+        a[3:, :] = False
     b = rng.random((L, w * 32)) < 0.1
     c_ref = (a.astype(np.float32) @ b.astype(np.float32)) > 0
 
     bp = pack_bool_columns(jnp.asarray(b))
     plan = PackedColsMatmulPlan(
-        M, L, w, use_xla=(mode == "xla"), interpret=(mode == "interpret"),
+        M, L, w, use_xla=(mode == "xla"), interpret=(mode != "xla"),
         tm=8, tl=16, tw=8,
+        skip_zero_tiles=(mode == "interpret-sparse"),
     )
     cp = np.asarray(plan(jnp.asarray(a, jnp.int8), bp))
     assert cp.shape == (M, w)

@@ -281,3 +281,16 @@ def test_sharded_rowpacked_state_is_sharded(mesh8):
     # each shard holds a [nc, wc/8] word-column block of every row
     shard_shapes = {s.data.shape for s in sp.addressable_shards}
     assert shard_shapes == {(eng.nc, eng.wc // 8)}
+
+
+def test_rowpacked_sparse_kernel_matches_oracle(small):
+    """The tile-skipping Pallas kernel (interpreted) is bit-identical to
+    the XLA formulation across all rules."""
+    norm, idx = small
+    eng = RowPackedSaturationEngine(
+        idx,
+        mm_opts={"skip_zero_tiles": True, "use_xla": False, "interpret": True},
+    )
+    assert all(mm.skip_zero_tiles for mm in eng._cr4_mm + eng._cr6_mm)
+    report = diff_engine_vs_oracle(norm, eng.saturate())
+    assert report.ok(), report.summary()
