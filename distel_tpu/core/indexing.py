@@ -198,6 +198,20 @@ class Indexer:
                 links.append((r, f))
             return lid
 
+        # intern NEW links sorted by role: with link rows grouped by role,
+        # the CR4/CR6 operand's nonzeros (closure-mask ∧ bit-table)
+        # cluster into role-diagonal tiles, which the tile-skipping matmul
+        # kernel then skips (measured 0.94 → 0.066 live-tile fraction on a
+        # 66-role corpus).  Previously-interned ids stay put — increments
+        # only append, preserving the stable-id contract above.
+        new_pairs = set()
+        for a, r, b in norm.nf3:
+            pair = (self.role(r), self.concept(b))
+            if pair not in link_ids:
+                new_pairs.add(pair)
+        for r, f in sorted(new_pairs):
+            link(r, f)
+
         for a, r, b in norm.nf3:
             nf3_rows.append((self.concept(a), link(self.role(r), self.concept(b))))
 
@@ -223,6 +237,10 @@ class Indexer:
 
         for r, a, b in norm.nf4:
             nf4_rows.append((self.role(r), self.concept(a), self.concept(b)))
+        # same tile-clustering for the operand ROW axis: the engines'
+        # matmul rows follow these arrays' order, so group them by role
+        nf4_rows.sort()
+        chain_pairs.sort()
 
         n_concepts = len(self.concept_names)
         original = [
@@ -273,3 +291,42 @@ def _role_closure(n_roles: int, edges: List[Tuple[int, int]]) -> np.ndarray:
 
 def index_ontology(norm: NormalizedOntology) -> IndexedOntology:
     return Indexer().index(norm)
+
+
+def role_sort_links(idx: IndexedOntology) -> IndexedOntology:
+    """Renumber link ids into role-grouped order and sort the CR4/CR6
+    row arrays by role — the tile-clustering contract the Python Indexer
+    establishes at interning time, applied as a post-pass for load
+    planes that intern in encounter order (the native loader).  NOT for
+    the incremental path: renumbering breaks the Indexer's stable-id
+    contract that lets a previous closure embed verbatim."""
+    import dataclasses
+
+    if idx.n_links == 0:
+        return idx
+    perm = np.argsort(idx.links[:, 0], kind="stable")
+    if (perm == np.arange(len(perm))).all() and _rows_sorted(
+        idx.nf4
+    ) and _rows_sorted(idx.chain_pairs):
+        return idx
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=perm.dtype)
+    nf3 = idx.nf3.copy()
+    if len(nf3):
+        nf3[:, 1] = inv[nf3[:, 1]]
+    cp = idx.chain_pairs.copy()
+    if len(cp):
+        cp[:, 1] = inv[cp[:, 1]]
+        cp[:, 2] = inv[cp[:, 2]]
+        cp = cp[np.lexsort((cp[:, 2], cp[:, 1], cp[:, 0]))]
+    nf4 = idx.nf4
+    if len(nf4):
+        nf4 = nf4[np.lexsort((nf4[:, 2], nf4[:, 1], nf4[:, 0]))]
+    return dataclasses.replace(
+        idx, links=idx.links[perm], nf3=nf3, nf4=nf4, chain_pairs=cp
+    )
+
+
+def _rows_sorted(a: np.ndarray) -> bool:
+    """Role-grouped check: first column (the role) non-decreasing."""
+    return len(a) < 2 or bool((np.diff(a[:, 0]) >= 0).all())

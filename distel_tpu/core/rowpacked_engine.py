@@ -74,7 +74,12 @@ from distel_tpu.core.engine import (
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
-from distel_tpu.ops.bitpack import SegmentedRowOr, bit_lookup
+from distel_tpu.ops.bitpack import (
+    SegmentedRowOr,
+    bit_lookup,
+    bit_lookup_from,
+    unpack_words,
+)
 
 
 class RowPackedSaturationEngine:
@@ -99,6 +104,7 @@ class RowPackedSaturationEngine:
         use_pallas: Optional[bool] = None,
         rules: Optional[frozenset] = None,
         mm_opts: Optional[dict] = None,
+        l_chunk: Optional[int] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -144,46 +150,16 @@ class RowPackedSaturationEngine:
         self._p3 = SegmentedRowOr(nf3[:, 1])
         self._src3 = nf3[self._p3.order, 0]
 
-        h = idx.role_closure
-        link_roles = idx.links[:, 0] if idx.n_links else np.zeros(0, np.int64)
-        fillers = np.zeros(self.nl, np.int64)
-        if idx.n_links:
-            fillers[: idx.n_links] = idx.links[:, 1]
-        self._fillers = fillers
-
-        # CR4/CR6: the seg-OR emission order is repeat-padded
-        # (SegmentedRowOr buckets), but repeats on the *matmul* path would
-        # be real redundant MXU work — so the matmul runs over the chunk's
-        # unique raw axioms and its packed output is expanded into padded
-        # emission order by a cheap row gather (``inv``) before the
-        # seg-OR.  The closure masks are device arrays passed as
-        # *arguments* to the jitted run — embedded as program constants
-        # they get serialized into every (remote) compile request, which
-        # breaks past ~100 MB.
+        # CR4/CR6 row plans (masks and link-table arrays are built later,
+        # once the final padded link-axis width is known)
         self._p4 = None
-        m4 = np.zeros((0, 0), np.int8)
         if len(idx.nf4) and idx.n_links and on("CR4"):
             self._p4 = SegmentedRowOr(idx.nf4[:, 2])
-            # m4[j, l] = H[role(l), s_j] — the link's role must be a
-            # (transitive) subrole of the axiom's s
-            m4 = np.zeros((len(idx.nf4), self.nl), np.int8)
-            m4[:, : idx.n_links] = h.T[idx.nf4[:, 0]][:, link_roles].astype(
-                np.int8
-            )
             self._a4 = idx.nf4[:, 1]
-
-        # CR6: chain second legs, same layout
         self._p6 = None
-        m6 = np.zeros((0, 0), np.int8)
         if len(idx.chain_pairs) and idx.n_links and on("CR6"):
             self._p6 = SegmentedRowOr(idx.chain_pairs[:, 2])
-            # m6[p, l] = H[role(l), r_p] — first-leg subrole closure
-            m6 = np.zeros((len(idx.chain_pairs), self.nl), np.int8)
-            m6[:, : idx.n_links] = h.T[idx.chain_pairs[:, 0]][
-                :, link_roles
-            ].astype(np.int8)
             self._l26 = idx.chain_pairs[:, 1]
-        self._masks = (jnp.asarray(m4), jnp.asarray(m6))
 
         self._bottom = bool(
             idx.has_bottom_axioms and idx.n_links and on("CR5")
@@ -220,10 +196,80 @@ class RowPackedSaturationEngine:
 
         self._cr4_chunks = mm_chunks(self._p4)
         self._cr6_chunks = mm_chunks(self._p6)
-        # one packed-output matmul plan per chunk (shard-local width).
-        # dtype: forwarded only when the caller pinned one — the Pallas
-        # kernel's own default (bf16 on TPU) wins otherwise; the engine's
-        # int8 preference applies to the XLA-formulated lookups/tables
+        # The contraction (link) axis is chunked too: a realistic
+        # many-role corpus at 96k classes has ~100k links, so the
+        # per-step [rk, nl] i8 operand (mask ∧ bit-table) alone would
+        # be gigabytes.  An AND-OR product ORs over L, so the step
+        # contracts one L-chunk at a time inside a ``lax.fori_loop`` —
+        # sequencing matters: as a Python loop XLA schedules every
+        # chunk's gathers concurrently and peak memory is back to the
+        # unchunked figure.  The link axis pads up to a whole number of
+        # equal chunks (padded links have all-zero mask bits — inert).
+        max_rk = max(
+            [len(raw) for raw, _, _ in self._cr4_chunks + self._cr6_chunks],
+            default=1,
+        )
+        if l_chunk is not None:
+            lc = min(_pad_up(max(l_chunk, 32), 32), self.nl)
+        else:
+            lc = min(
+                _pad_up(max(temp_budget_bytes // 2 // max(max_rk, 1), 32), 32),
+                self.nl,
+            )
+        self.n_lchunks = -(-self.nl // lc)
+        # even the chunks out: taking the budget maximum as-is can round
+        # nl up by almost a whole chunk of inert links (R rows + mask
+        # bits); re-deriving lc from the chunk count bounds the padding
+        # at 32 * n_lchunks links
+        lc = _pad_up(-(-self.nl // self.n_lchunks), 32)
+        self.nl = self.n_lchunks * lc
+        self.lc = lc
+
+        # link-table arrays at the final width
+        h = idx.role_closure
+        link_roles = idx.links[:, 0] if idx.n_links else np.zeros(0, np.int64)
+        fillers = np.zeros(self.nl, np.int64)
+        if idx.n_links:
+            fillers[: idx.n_links] = idx.links[:, 1]
+        self._fillers = fillers
+
+        # The closure masks are stored BIT-PACKED along the link axis
+        # ([K, nl/32] u32 — byte-wide masks would be 5 GB at the 96k
+        # many-role scale) and unpacked one L-chunk at a time in the
+        # step; they are device arrays passed as *arguments* to the
+        # jitted run — embedded as program constants they get serialized
+        # into every (remote) compile request, which breaks past ~100 MB.
+        def packed_mask(roles: np.ndarray) -> np.ndarray:
+            """rows[j, l] = H[role(l), roles[j]], bit-packed along l.
+            Built in row blocks: the full byte-wide mask is the multi-GB
+            allocation the packing exists to avoid."""
+            out = np.zeros((len(roles), self.nl // 32), np.uint32)
+            hl = h[link_roles]                      # [n_links, n_roles]
+            for j0 in range(0, len(roles), 4096):
+                rs = roles[j0 : j0 + 4096]
+                m = np.zeros((len(rs), self.nl), bool)
+                m[:, : idx.n_links] = hl[:, rs].T
+                out[j0 : j0 + 4096] = np.ascontiguousarray(
+                    np.packbits(m, axis=1, bitorder="little")
+                ).view(np.uint32)
+            return out
+
+        m4 = np.zeros((0, 0), np.uint32)
+        if self._p4 is not None:
+            # m4[j, l] = H[role(l), s_j] — the link's role must be a
+            # (transitive) subrole of the axiom's s
+            m4 = packed_mask(idx.nf4[:, 0])
+        m6 = np.zeros((0, 0), np.uint32)
+        if self._p6 is not None:
+            # m6[p, l] = H[role(l), r_p] — first-leg subrole closure
+            m6 = packed_mask(idx.chain_pairs[:, 0])
+        self._masks = (jnp.asarray(m4), jnp.asarray(m6))
+
+        # one packed-output matmul plan per row-chunk, shared by every
+        # (equal-sized) L-chunk.  dtype: forwarded only when the caller
+        # pinned one — the Pallas kernel's own default (bf16 on TPU) wins
+        # otherwise; the engine's int8 preference applies to the
+        # XLA-formulated lookups/tables
         mm_kw = {"use_xla": not use_pallas}
         if matmul_dtype is not None:
             mm_kw["dtype"] = matmul_dtype
@@ -231,11 +277,11 @@ class RowPackedSaturationEngine:
             mm_kw.update(mm_opts)
         wl = self.wc // self.n_shards
         self._cr4_mm = [
-            PackedColsMatmulPlan(len(raw), self.nl, wl, **mm_kw)
+            PackedColsMatmulPlan(len(raw), lc, wl, **mm_kw)
             for raw, _, _ in self._cr4_chunks
         ]
         self._cr6_mm = [
-            PackedColsMatmulPlan(len(raw), self.nl, wl, **mm_kw)
+            PackedColsMatmulPlan(len(raw), lc, wl, **mm_kw)
             for raw, _, _ in self._cr6_chunks
         ]
 
@@ -397,7 +443,9 @@ class RowPackedSaturationEngine:
         lives on exactly one shard, so a masked local lookup + psum IS
         the exchange — the only cross-shard data of the whole step (the
         packed analog of the reference's delta reads against the result
-        node, ``base/Type2AxiomProcessorBase.java:101-116``)."""
+        node, ``base/Type2AxiomProcessorBase.java:101-116``).  The
+        CR4/CR6 L-chunk loop uses ``bit_lookup_from`` directly; this
+        full-width variant serves CR5's ⊥-filler mask."""
         dt = self.matmul_dtype
         cols = self._fillers
         if axis_name is None:
@@ -437,21 +485,63 @@ class RowPackedSaturationEngine:
         # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
         # HBM end to end (the Pallas kernel unpacks/repacks per VMEM tile;
         # the XLA fallback materializes the wide operands instead).  The
-        # matmul contracts over the chunk's unique raw axioms; its packed
-        # output rows are then gathered into the seg-OR's repeat-padded
-        # emission order (packed-row copies are ~free next to MXU work)
+        # matmul contracts over the chunk's unique raw axioms and OR-
+        # accumulates over L-chunks inside a ``fori_loop`` (partial
+        # AND-OR products just OR; sequencing bounds peak memory to one
+        # chunk's temporaries — see __init__).  Per chunk the bit-packed
+        # mask slice unpacks to [rk, Lc] i8.  The packed output rows are
+        # then gathered into the seg-OR's repeat-padded emission order
+        # (packed-row copies are ~free next to MXU work)
+        dt = self.matmul_dtype
+        lc = self.lc
+        wlw = rp.shape[1]
+        fillers2d = jnp.asarray(
+            self._fillers.reshape(self.n_lchunks, lc).astype(np.int32)
+        )
+        base = (
+            None
+            if axis_name is None
+            else lax.axis_index(axis_name) * (self.wc // self.n_shards)
+        )
+
+        def contract(state_for_bits, rows, mask_rows, mm):
+            rk = len(rows)
+            subt = state_for_bits[jnp.asarray(rows)].T    # [W, rk], hoisted
+
+            def one(i, acc):
+                if axis_name is None:
+                    f = bit_lookup_from(subt, fillers2d[i], dtype=dt)
+                else:
+                    f = lax.psum(
+                        bit_lookup_from(
+                            subt, fillers2d[i],
+                            word_offset=base, dtype=jnp.int32,
+                        ),
+                        axis_name,
+                    ).astype(dt)                          # [lc, rk]
+                mw = lax.dynamic_slice(
+                    mask_rows, (0, i * (lc // 32)), (rk, lc // 32)
+                )
+                w = unpack_words(mw, lc, dtype=dt) * f.T  # [rk, lc]
+                b = lax.dynamic_slice(rp, (i * lc, 0), (lc, wlw))
+                return acc | mm(w, b)
+
+            if self.n_lchunks == 1:
+                return one(0, jnp.zeros((rk, wlw), jnp.uint32))
+            return lax.fori_loop(
+                0, self.n_lchunks, one, jnp.zeros((rk, wlw), jnp.uint32)
+            )
+
         if self._p4 is not None:
             for (raw, inv, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
-                f4 = self._bit_table(sp, self._a4[raw], axis_name)  # [nl, rk]
-                w = m4[raw] * f4.T
-                sp, c = plan.apply(sp, mm(w, rp)[inv], track=True)
+                out = contract(sp, self._a4[raw], m4[raw], mm)
+                sp, c = plan.apply(sp, out[inv], track=True)
                 ch |= c
         # CR6: role chains
         if self._p6 is not None:
             for (raw, inv, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
-                f6 = self._bit_table(rp, self._l26[raw], axis_name)  # [nl, rk]
-                d = m6[raw] * f6.T
-                rp, c = plan.apply(rp, mm(d, rp)[inv], track=True)
+                out = contract(rp, self._l26[raw], m6[raw], mm)
+                rp, c = plan.apply(rp, out[inv], track=True)
                 ch |= c
         # CR5: ⊥ back-propagation — one masked packed OR-reduce
         if self._bottom:

@@ -4,6 +4,12 @@ Equivalents of the reference's corpus tooling:
   * ``synthetic_ontology``   — deterministic EL+ generator (the scale tool
     behind weak-scaling runs; plays the role of the reference's
     ``samples/OntologyMultiplier.java`` synthetic corpora).
+  * ``snomed_shaped_ontology`` — deterministic generator with SNOMED CT's
+    *role structure*: tens of object properties under a role hierarchy,
+    role-group-style conjunctive definitions, transitive partonomy and
+    right-identity chains.  The reference's evaluation corpus is SNOMED
+    (``ShardInfo.properties:27`` chunk-tuning notes); this generator
+    reproduces its axiom-shape mix where the real release cannot ship.
   * ``multiply_ontology``    — n-copy entity renaming and "crossed"
     duplication (reference ``samples/OntologyMultiplier.java:32-88`` and
     :97-…: copy k gets every axiom with entities renamed E→E_k; crossed
@@ -58,6 +64,102 @@ def synthetic_ontology(
         lines.append(
             f"EquivalentClasses(Def{i} ObjectIntersectionOf(C{c} "
             f"ObjectSomeValuesFrom(hasLoc Anat{a})))"
+        )
+    return "\n".join(lines)
+
+
+def snomed_shaped_ontology(
+    n_classes: int = 2000,
+    n_roles: int = 60,
+    n_defs: int | None = None,
+    n_assertions: int | None = None,
+    seed: int = 42,
+) -> str:
+    """Deterministic EL+ corpus with SNOMED CT's role structure.
+
+    Shape (mirroring the SNOMED release this framework targets as its
+    north-star corpus, BASELINE.md):
+
+    * five top-level areas (finding, procedure, body, substance,
+      organism) of multi-parent is-a DAGs — ~20% of classes get a second
+      parent, like SNOMED's DAG;
+    * ``n_roles`` attributes in a two-level role hierarchy (SNOMED has
+      ~60 active attributes, most under a handful of groupers);
+    * a transitive partonomy over body structures plus right-identity
+      chains (SNOMED's ``direct-substance o has-ingredient``-style
+      axioms);
+    * fully-defined concepts as role-group conjunctions: parent ∧
+      ∃attr.filler [∧ ∃attr'.filler'] — the dominant SNOMED axiom shape;
+    * primitive existential assertions for the rest.
+
+    Unlike :func:`synthetic_ontology` (3 roles), the many-role structure
+    makes the CR4/CR6 closure masks block-sparse — the realistic regime
+    for the tile-skipping matmul kernel."""
+    rng = random.Random(seed)
+    n_defs = n_classes // 8 if n_defs is None else n_defs
+    n_assertions = n_classes // 4 if n_assertions is None else n_assertions
+    areas = ["Find", "Proc", "Body", "Subst", "Org"]
+    per_area = max(n_classes // len(areas), 2)
+    lines: List[str] = []
+
+    # role hierarchy: grouper roles attrG0.. + leaf roles under them
+    n_groupers = max(n_roles // 12, 1)
+    for g in range(n_groupers):
+        lines.append(f"SubObjectPropertyOf(attrG{g} attrG0)")
+    for r in range(n_roles):
+        g = rng.randrange(n_groupers)
+        lines.append(f"SubObjectPropertyOf(attr{r} attrG{g})")
+    lines.append("TransitiveObjectProperty(partOf)")
+    lines.append("SubObjectPropertyOf(partOf attrG0)")
+    # right-identity chains on a few leaf roles (SNOMED has ~10)
+    for r in range(0, min(8, n_roles)):
+        lines.append(
+            f"SubObjectPropertyOf(ObjectPropertyChain(attr{r} partOf) attr{r})"
+        )
+    lines.append("ObjectPropertyDomain(attr0 Find)")
+    lines.append("ObjectPropertyRange(attr0 Body)")
+
+    # multi-parent is-a DAGs per area
+    for area in areas:
+        for i in range(1, per_area):
+            lines.append(f"SubClassOf({area}{i} {area}{i // 2})")
+            if i > 3 and rng.random() < 0.2:
+                lines.append(
+                    f"SubClassOf({area}{i} {area}{rng.randrange(1, i)})"
+                )
+    # partonomy over body structures
+    for i in range(2, per_area):
+        if rng.random() < 0.4:
+            lines.append(
+                f"SubClassOf(Body{i} ObjectSomeValuesFrom(partOf Body{i // 2}))"
+            )
+
+    filler_areas = ["Body", "Subst", "Org"]
+
+    def filler(r: random.Random) -> str:
+        return f"{r.choice(filler_areas)}{r.randrange(1, per_area)}"
+
+    # fully-defined concepts: parent ∧ ∃attr.filler [∧ ∃attr'.filler']
+    for i in range(n_defs):
+        area = rng.choice(["Find", "Proc"])
+        parent = f"{area}{rng.randrange(1, per_area)}"
+        a1, a2 = rng.randrange(n_roles), rng.randrange(n_roles)
+        conj = [
+            parent,
+            f"ObjectSomeValuesFrom(attr{a1} {filler(rng)})",
+        ]
+        if rng.random() < 0.5:
+            conj.append(f"ObjectSomeValuesFrom(attr{a2} {filler(rng)})")
+        lines.append(
+            f"EquivalentClasses(SCT{i} ObjectIntersectionOf({' '.join(conj)}))"
+        )
+    # primitive existential assertions
+    for _ in range(n_assertions):
+        area = rng.choice(areas)
+        c = f"{area}{rng.randrange(1, per_area)}"
+        a = rng.randrange(n_roles)
+        lines.append(
+            f"SubClassOf({c} ObjectSomeValuesFrom(attr{a} {filler(rng)}))"
         )
     return "\n".join(lines)
 

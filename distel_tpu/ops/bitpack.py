@@ -27,10 +27,14 @@ def pack_bool_columns(x) -> jnp.ndarray:
 
 
 def unpack_words(p, m: int, dtype=bool) -> jnp.ndarray:
-    """uint32 [N, W] → ``dtype`` [N, m] (m <= 32*W), standard layout."""
+    """uint32 [N, W] → ``dtype`` [N, m] (m <= 32*W), standard layout.
+    Bits narrow to ``dtype`` before the reshape so the widest live value
+    is the [N, W, 32] ``dtype`` tensor (1 byte/bit for int8), not u32."""
     shifts = jnp.arange(32, dtype=jnp.uint32)
-    bits = (p[:, :, None] >> shifts) & jnp.asarray(1, jnp.uint32)
-    return bits.reshape(p.shape[0], -1)[:, :m].astype(dtype)
+    bits = ((p[:, :, None] >> shifts) & jnp.asarray(1, jnp.uint32)).astype(
+        dtype
+    )
+    return bits.reshape(p.shape[0], -1)[:, :m]
 
 
 def unpack_words_planes(p, dtype=jnp.int8) -> jnp.ndarray:
@@ -60,10 +64,28 @@ def pack_planes(bits) -> jnp.ndarray:
     return jnp.sum(b3 * weights, axis=1, dtype=jnp.uint32)
 
 
+def bit_lookup_from(subt, cols, *, word_offset=None, dtype=bool) -> jnp.ndarray:
+    """``out[j, i] = bit(subt[cols[j] >> 5, i] >> (cols[j] & 31))`` —
+    the column-lookup half of :func:`bit_lookup` over a precomputed
+    transposed row gather ``subt`` [W, R].  ``cols`` may be a *traced*
+    index vector, so callers can loop over column chunks with
+    ``lax.fori_loop`` (bounding peak memory to one chunk's temporaries).
+    """
+    cols = jnp.asarray(cols)
+    w = lax.shift_right_logical(cols, 5)
+    if word_offset is not None:
+        w = w - word_offset
+    ok = (w >= 0) & (w < subt.shape[0])
+    words = subt[jnp.clip(w, 0, subt.shape[0] - 1)]    # [C, R] row gather
+    shifts = (cols & 31).astype(jnp.uint32)[:, None]
+    bits = (words >> shifts) & jnp.asarray(1, jnp.uint32)
+    return jnp.where(ok[:, None], bits, 0).astype(dtype)
+
+
 def bit_lookup(
     p,
     rows: np.ndarray,
-    cols: np.ndarray,
+    cols,
     *,
     word_offset=None,
     dtype=bool,
@@ -81,18 +103,11 @@ def bit_lookup(
     holds only the word window ``[word_offset, word_offset + W)``:
     out-of-window columns yield 0 (the caller psums the partials)."""
     rows = np.asarray(rows)
-    cols = np.asarray(cols)
-    if rows.size == 0 or cols.size == 0:
-        return jnp.zeros((cols.size, rows.size), dtype)
+    n_cols = cols.size if hasattr(cols, "size") else np.asarray(cols).size
+    if rows.size == 0 or n_cols == 0:
+        return jnp.zeros((n_cols, rows.size), dtype)
     subt = p[jnp.asarray(rows)].T             # [W, R] (one transpose copy)
-    w = jnp.asarray(cols >> 5)
-    if word_offset is not None:
-        w = w - word_offset
-    ok = (w >= 0) & (w < subt.shape[0])
-    words = subt[jnp.clip(w, 0, subt.shape[0] - 1)]    # [C, R] row gather
-    shifts = jnp.asarray((cols & 31).astype(np.uint32))[:, None]
-    bits = (words >> shifts) & jnp.asarray(1, jnp.uint32)
-    return jnp.where(ok[:, None], bits, 0).astype(dtype)
+    return bit_lookup_from(subt, cols, word_offset=word_offset, dtype=dtype)
 
 
 def gather_bit_columns(p, cols: np.ndarray) -> jnp.ndarray:

@@ -149,7 +149,12 @@ def load_indexed(text: str) -> IndexedOntology:
             or bool((nf2[:, 2] == 0).any())
             or bool((nf4[:, 2] == 0).any())
         )
-        return IndexedOntology(
+        # the native plane interns links in encounter order; re-group by
+        # role so the engines' tile-sparse matmul sees clustered masks
+        # (same contract the Python Indexer establishes at interning)
+        from distel_tpu.core.indexing import role_sort_links
+
+        return role_sort_links(IndexedOntology(
             n_concepts=int(r.n_concepts),
             n_roles=max(int(r.n_roles), 1),
             concept_names=concept_names,
@@ -166,7 +171,7 @@ def load_indexed(text: str) -> IndexedOntology:
             original_classes=np.asarray(original, np.int32),
             has_bottom_axioms=has_bottom,
             removed=removed,
-        )
+        ))
     finally:
         lib.distel_free(res)
 

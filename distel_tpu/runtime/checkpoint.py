@@ -75,18 +75,141 @@ def _info(z) -> dict:
 
 
 def load_snapshot_state(
-    path: str, unpack: bool = False
+    path: str,
+    unpack: bool = False,
+    idx: Optional[IndexedOntology] = None,
 ) -> Tuple[Tuple[np.ndarray, np.ndarray], dict]:
     """Resume-oriented load: returns ``(state, info)`` where ``state``
     feeds ``engine.saturate(initial=state)``.  For v2 snapshots the
     default is the wire-packed uint32 pair, which re-embeds without
     densifying but is only understood by the **row-packed** engine; pass
-    ``unpack=True`` to get the x-major bool pair any engine accepts."""
+    ``unpack=True`` to get the x-major bool pair any engine accepts.
+
+    Pass ``idx`` (the index the resuming engine was built from) to remap
+    the state BY NAME onto that index's ids: a fresh load of a grown
+    corpus — or a switch between the Python and native load planes —
+    renumbers concepts and links, and a positional re-embed would
+    silently attach old rows to the wrong entities.  Omitting ``idx`` is
+    only sound when resuming against the very numbering the snapshot was
+    taken under (same session, or a persistent ``Indexer``)."""
     z = np.load(path, allow_pickle=True)
     if "s_wire" in z and not unpack:
-        return (z["s_wire"], z["r_wire"]), _info(z)
-    s, r, info = _load_unpacked(z)
-    return (s, r), info
+        state, info = (z["s_wire"], z["r_wire"]), _info(z)
+    else:
+        s, r, info = _load_unpacked(z)
+        state = (s, r)
+    if idx is not None:
+        state = align_snapshot_state(state, info, idx)
+    return state, info
+
+
+def align_snapshot_state(
+    state: Tuple[np.ndarray, np.ndarray], info: dict, idx: IndexedOntology
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Remap a loaded snapshot onto ``idx``'s entity/link numbering.
+
+    Matching is by *name*: concepts via ``concept_names``, links via
+    (role name, filler name).  Id assignment order is a property of the
+    load plane and corpus growth history (sorted atom interning,
+    role-sorted link interning), so resuming against a freshly-built
+    index must not assume positional stability.  Entities absent from
+    ``idx`` are dropped (their derived rows are meaningless there);
+    when the old numbering is a prefix of the new one — the persistent
+    ``Indexer`` contract — this is a no-copy identity."""
+    old_cnames = list(info["concept_names"])
+    old_rnames = list(info["role_names"])
+    old_links = np.asarray(info["links"])
+    cmap_raw = np.asarray(
+        [idx.concept_ids.get(nm, -1) for nm in old_cnames], np.int64
+    )
+    new_link_ids = {
+        (int(r), int(f)): i for i, (r, f) in enumerate(idx.links)
+    }
+    if (cmap_raw == np.arange(len(old_cnames))).all():
+        # exact same numbering (the persistent-Indexer contract) — the
+        # common fast path, and the only case where generated names are
+        # trustworthy
+        lmap_id = _link_map(old_links, old_rnames, cmap_raw, new_link_ids, idx)
+        if (lmap_id == np.arange(len(old_links))).all():
+            return state
+    # Generated names (gensym/aux) are PLANE- and HISTORY-dependent: the
+    # same "distel:gensym#415" denotes different filler expressions in
+    # the Python and native normalizers, so matching them by name would
+    # inject wrong rows.  Drop them — a warm start may be any sound
+    # subset of a closure; the resumed saturation re-derives the rest.
+    cmap = cmap_raw.copy()
+    for i, nm in enumerate(old_cnames):
+        if nm.startswith(("distel:gensym#", "distel:aux#")):
+            cmap[i] = -1
+    lmap = _link_map(old_links, old_rnames, cmap, new_link_ids, idx)
+    n_old = len(old_cnames)
+    s, r = np.asarray(state[0]), np.asarray(state[1])
+    if s.dtype == np.uint32:
+        return (
+            _remap_packed(s, cmap, cmap, idx.n_concepts, n_old),
+            _remap_packed(r, lmap, cmap, idx.n_links, n_old),
+        )
+    # x-major bool [x, a] / [x, l]
+    vx = np.nonzero(cmap >= 0)[0]
+    s_new = np.zeros((idx.n_concepts, idx.n_concepts), bool)
+    s_new[np.ix_(cmap[vx], cmap[vx])] = s[np.ix_(vx, vx)]
+    vl = np.nonzero(lmap >= 0)[0]
+    r_new = np.zeros((idx.n_concepts, idx.n_links), bool)
+    if len(vl):
+        r_new[np.ix_(cmap[vx], lmap[vl])] = r[np.ix_(vx, vl)]
+    return s_new, r_new
+
+
+def _link_map(
+    old_links: np.ndarray,
+    old_rnames: list,
+    cmap: np.ndarray,
+    new_link_ids: dict,
+    idx: IndexedOntology,
+) -> np.ndarray:
+    """old link id → new link id via (role name, mapped filler)."""
+    lmap = np.full(len(old_links), -1, np.int64)
+    for i, (r, f) in enumerate(old_links):
+        nr = idx.role_ids.get(old_rnames[r], -1)
+        nf = cmap[f]
+        if nr >= 0 and nf >= 0:
+            lmap[i] = new_link_ids.get((nr, int(nf)), -1)
+    return lmap
+
+
+def _remap_packed(
+    p: np.ndarray,
+    row_map: np.ndarray,
+    bit_map: np.ndarray,
+    n_new_rows: int,
+    n_old_bits: int,
+    block: int = 4096,
+) -> np.ndarray:
+    """Remap a wire-packed [row, xw] uint32 array: row i → row_map[i],
+    bit x → bit_map[x] (negatives dropped).  Processed in row blocks so
+    the transient bool view stays bounded."""
+    n_new_bits = int(bit_map.max()) + 1 if (bit_map >= 0).any() else 1
+    out_w = (n_new_bits + 31) // 32
+    out = np.zeros((n_new_rows, out_w), np.uint32)
+    valid_bits = np.nonzero(bit_map[: min(n_old_bits, p.shape[1] * 32)] >= 0)[0]
+    tgt_bits = bit_map[valid_bits]
+    pad_bits = ((n_new_bits + 31) // 32) * 32
+    for i0 in range(0, min(p.shape[0], len(row_map)), block):
+        rows = p[i0 : i0 + block]
+        rmap = row_map[i0 : i0 + block]
+        keep = np.nonzero((rmap >= 0) & (rmap < n_new_rows))[0]
+        if not len(keep):
+            continue
+        bits = np.unpackbits(
+            rows[keep].view(np.uint8), axis=1, bitorder="little"
+        )
+        blk = np.zeros((len(keep), pad_bits), np.uint8)
+        blk[:, tgt_bits] = bits[:, valid_bits]
+        packed = np.packbits(blk, axis=1, bitorder="little")
+        out[rmap[keep]] = (
+            np.ascontiguousarray(packed).view(np.uint32)
+        )
+    return out
 
 
 def _load_unpacked(z) -> Tuple[np.ndarray, np.ndarray, dict]:

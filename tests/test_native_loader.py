@@ -159,3 +159,52 @@ def test_native_removed_in_summary():
         "SubClassOf(A B)\nSubClassOf(C ObjectUnionOf(D E))"
     )
     assert res.summary()["removed_axioms"] == 1
+
+
+def test_native_links_role_grouped():
+    """The native plane's links arrive role-grouped (role_sort_links
+    post-pass) so the engines' tile-sparse matmul sees clustered masks,
+    and the CR4/CR6 row arrays are role-sorted for the same reason."""
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+
+    idx = native_loader.load_indexed(
+        snomed_shaped_ontology(n_classes=400, n_roles=24)
+    )
+    assert idx.n_links > 0
+    assert (np.diff(idx.links[:, 0]) >= 0).all()
+    if len(idx.nf4) > 1:
+        assert (np.diff(idx.nf4[:, 0]) >= 0).all()
+    if len(idx.chain_pairs) > 1:
+        assert (np.diff(idx.chain_pairs[:, 0]) >= 0).all()
+
+
+def test_cross_plane_snapshot_resume():
+    """A snapshot saved from the Python plane resumes against the native
+    plane's numbering: generated (gensym/aux) entities are dropped at
+    alignment — their names collide across planes while denoting
+    different expressions — and re-derived by the resumed saturation."""
+    import os
+    import tempfile
+
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+    from distel_tpu.runtime.checkpoint import (
+        load_snapshot_state,
+        save_snapshot,
+    )
+
+    text = snomed_shaped_ontology(n_classes=300, n_roles=16)
+    pidx = index_ontology(normalize(parser.parse(text)))
+    pres = RowPackedSaturationEngine(pidx).saturate()
+    nidx = native_loader.load_indexed(text)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "s.npz")
+        save_snapshot(p, pres)
+        state, _ = load_snapshot_state(p, idx=nidx)
+        resumed = RowPackedSaturationEngine(nidx).saturate(initial=state)
+    fresh = RowPackedSaturationEngine(nidx).saturate()
+    orig = set(nidx.original_classes.tolist())
+    for c in nidx.original_classes.tolist():
+        a = {s for s in resumed.subsumers(c) if s in orig}
+        b = {s for s in fresh.subsumers(c) if s in orig}
+        assert a == b, nidx.concept_names[c]

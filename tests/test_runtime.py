@@ -429,3 +429,54 @@ def test_progress_reporter_echo(capsys):
     assert "iter=2" in out and "fraction=1.000" in out.splitlines()[-1]
     assert "fraction=0.000" in out.splitlines()[0]
     assert r.records[0].rate >= 0
+
+
+def test_snapshot_resume_across_renumbered_index():
+    """Resume must not depend on id assignment order: a fresh load of a
+    grown corpus (or a switch of load plane) renumbers concepts and
+    links, and load_snapshot_state(idx=...) realigns the state by name
+    (positional re-embed would silently corrupt the closure)."""
+    import os
+    import tempfile
+
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+    from distel_tpu.runtime.checkpoint import load_snapshot_state
+    from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+    def _indexed(text):
+        norm = normalize(parser.parse(text))
+        return norm, index_ontology(norm)
+
+    base = (
+        "SubClassOf(Cat Mammal)\n"
+        "SubClassOf(Mammal Animal)\n"
+        "SubClassOf(Cat ObjectSomeValuesFrom(partOf Zoo))\n"
+        "SubClassOf(ObjectSomeValuesFrom(partOf Zoo) Captive)\n"
+    )
+    # the growth axioms introduce names/links that sort BEFORE the old
+    # ones, so a fresh index renumbers everything
+    grown = (
+        "SubClassOf(Aardvark Mammal)\n"
+        "SubClassOf(Aardvark ObjectSomeValuesFrom(ate Ant))\n"
+        "SubClassOf(ObjectSomeValuesFrom(ate Ant) AntEater)\n"
+    ) + base
+    norm_a, idx_a = _indexed(base)
+    res_a = RowPackedSaturationEngine(idx_a).saturate()
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "snap.npz")
+        save_snapshot(p, res_a)
+        norm_b, idx_b = _indexed(grown)
+        # renumbering really happened (else this test is vacuous)
+        assert idx_a.concept_names != idx_b.concept_names[: len(idx_a.concept_names)]
+        eng_b = RowPackedSaturationEngine(idx_b)
+        state, info = load_snapshot_state(p, idx=idx_b)
+        resumed = eng_b.saturate(initial=state)
+        report = diff_engine_vs_oracle(norm_b, resumed)
+        assert report.ok(), report.summary()
+        # and the x-major (unpack=True) route aligns too
+        state_u, _ = load_snapshot_state(p, unpack=True, idx=idx_b)
+        resumed_u = eng_b.saturate(initial=state_u)
+        assert resumed_u.derivations == resumed.derivations
