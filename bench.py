@@ -23,7 +23,10 @@ sys.path.insert(0, ".")
 
 from distel_tpu.owl import parser  # noqa: E402
 from distel_tpu.frontend.normalizer import normalize  # noqa: E402
-from distel_tpu.frontend.ontology_tools import synthetic_ontology  # noqa: E402
+from distel_tpu.frontend.ontology_tools import (  # noqa: E402
+    snomed_shaped_ontology,
+    synthetic_ontology,
+)
 from distel_tpu.core.indexing import index_ontology  # noqa: E402
 from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine  # noqa: E402
 from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
@@ -72,6 +75,23 @@ def main() -> None:
     oracle_s = time.time() - t0
     oracle_dps = oracle_result.derivation_count() / oracle_s
 
+    # secondary figure (default invocations only — a custom size means a
+    # quick targeted run): the SNOMED-structured corpus, the many-role
+    # regime of the reference's own evaluation ontology; exercises the
+    # role-clustered tile-sparse matmul path
+    snomed_fields = {}
+    if len(sys.argv) <= 1:
+        stext = snomed_shaped_ontology(n_classes=24000)
+        sidx = index_ontology(normalize(parser.parse(stext)))
+        sengine = RowPackedSaturationEngine(sidx)
+        sres = sengine.saturate()
+        s_warm = min(_timed(sengine.saturate) for _ in range(3))
+        snomed_fields = {
+            "snomed_shaped_24k_concepts": sidx.n_concepts,
+            "snomed_shaped_24k_wall_s_warm": round(s_warm, 3),
+            "snomed_shaped_24k_dps": round(sres.derivations / s_warm, 1),
+        }
+
     print(
         json.dumps(
             {
@@ -87,6 +107,7 @@ def main() -> None:
                 "wall_s_warm": round(warm_s, 3),
                 "wall_s_cold": round(cold_s, 3),
                 "baseline_cpu_dps": round(oracle_dps, 1),
+                **snomed_fields,
             }
         )
     )

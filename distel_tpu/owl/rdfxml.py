@@ -311,3 +311,21 @@ def parse(text: str) -> S.Ontology:
 def parse_file(path: str) -> S.Ontology:
     with open(path, "r", encoding="utf-8") as f:
         return parse(f.read())
+
+
+def wrap_fragment(body: str, extra_namespaces: str = "") -> str:
+    """Wrap a headerless RDF/XML *fragment* (node elements only) into a
+    complete ``rdf:RDF`` document — the reference streams per-interval
+    traffic files that lack the envelope and prepends/appends it with
+    ``HeaderFooterAdder.java`` before loading; this is that utility for
+    the streaming CLI.  ``extra_namespaces`` is spliced into the root
+    element verbatim (e.g. ``xmlns:dc="..."``)."""
+    return (
+        '<?xml version="1.0"?>\n'
+        '<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#"\n'
+        '         xmlns:rdfs="http://www.w3.org/2000/01/rdf-schema#"\n'
+        '         xmlns:owl="http://www.w3.org/2002/07/owl#"\n'
+        f'         {extra_namespaces}>\n'
+        f"{body}\n"
+        "</rdf:RDF>\n"
+    )

@@ -262,3 +262,70 @@ def test_data_expressions_across_readers():
     assert any(
         f and f.endswith("PlainLiteral") for f in fillers(fs2)
     )
+
+
+def test_rdf_fragment_wrapping():
+    """Headerless RDF/XML fragments (the reference's streamed traffic
+    files, enveloped by HeaderFooterAdder.java) load transparently."""
+    from distel_tpu.owl.loader import load
+    from distel_tpu.owl import syntax as S
+
+    fragment = (
+        '<owl:Class rdf:about="http://ex.org#Car">\n'
+        '  <rdfs:subClassOf rdf:resource="http://ex.org#Vehicle"/>\n'
+        "</owl:Class>\n"
+        '<owl:Class rdf:about="http://ex.org#Bus">\n'
+        '  <rdfs:subClassOf rdf:resource="http://ex.org#Vehicle"/>\n'
+        "</owl:Class>"
+    )
+    onto = load(fragment)
+    subs = {
+        (a.sub.iri, a.sup.iri)
+        for a in onto.axioms
+        if isinstance(a, S.SubClassOf)
+        and isinstance(a.sub, S.Class)
+        and isinstance(a.sup, S.Class)
+    }
+    assert ("http://ex.org#Car", "http://ex.org#Vehicle") in subs
+    assert ("http://ex.org#Bus", "http://ex.org#Vehicle") in subs
+
+
+def test_rdf_fragment_error_reporting():
+    """Non-fragment parse errors keep the user's coordinates; fragments
+    with exotic prefixes get an actionable message."""
+    import pytest
+    from xml.etree import ElementTree
+
+    from distel_tpu.owl.loader import load
+
+    with pytest.raises(ElementTree.ParseError) as e:
+        load('<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#">')
+    assert "line 1" in str(e.value)
+    with pytest.raises(ValueError, match="wrap_fragment"):
+        load(
+            '<owl:Class rdf:about="http://e#A"><dc:creator>x</dc:creator>'
+            "</owl:Class>\n"
+            '<owl:Class rdf:about="http://e#B"/>'
+        )
+
+
+def test_root_element_scan_skips_comments():
+    """A leading comment containing element-like text must not fool the
+    root-element scan in either direction."""
+    from distel_tpu.owl.loader import _root_element_local, detect_format, load
+
+    frag = (
+        "<!-- see the <RDF> spec -->\n"
+        '<owl:Class rdf:about="http://e#A">\n'
+        '  <rdfs:subClassOf rdf:resource="http://e#V"/>\n'
+        "</owl:Class>\n"
+        '<owl:Class rdf:about="http://e#B"/>'
+    )
+    assert _root_element_local(frag) == "Class"
+    assert len(load(frag).axioms) == 1
+    full = (
+        "<!-- mentions <x> -->\n"
+        '<rdf:RDF xmlns:rdf="http://www.w3.org/1999/02/22-rdf-syntax-ns#"/>'
+    )
+    assert _root_element_local(full) == "RDF"
+    assert detect_format(full) == "rdfxml"
