@@ -118,9 +118,11 @@ class SaturationResult:
     _r: Optional[np.ndarray] = field(default=None, repr=False)
 
     def _fetch(self) -> None:
-        """One-time D2H transfer of the packed closure (no-op if host-side)."""
+        """One-time D2H transfer of the packed closure (no-op if
+        host-side).  Under a multi-controller run this is a collective
+        allgather — every process must read in the same order."""
         if not isinstance(self.packed_s, np.ndarray):
-            self.packed_s, self.packed_r = jax.device_get(
+            self.packed_s, self.packed_r = fetch_global(
                 (self.packed_s, self.packed_r)
             )
 
@@ -173,7 +175,7 @@ def observed_loop(
     while iteration < budget:
         s, r, changed_dev, bits = observe_step(s, r)
         iteration += unroll
-        changed, bits_host = jax.device_get((changed_dev, bits))
+        changed, bits_host = fetch_global((changed_dev, bits))
         total = _host_bit_total(bits_host)
         if observer is not None:
             observer(iteration, total - init_total, bool(changed))
@@ -181,6 +183,24 @@ def observed_loop(
             converged = True
             break
     return s, r, iteration, total, converged
+
+
+def fetch_global(tree):
+    """``jax.device_get`` that also works on arrays spanning other
+    processes' devices (multi-controller runs): such arrays are gathered
+    with ``process_allgather``, which is a collective — every process
+    must call this on the same values, which they do (SPMD epilogue).
+    The fallback is selected per leaf by addressability, so unrelated
+    ``RuntimeError``s (e.g. a donated buffer) surface unchanged."""
+
+    def get(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return jax.device_get(x)
+
+    return jax.tree_util.tree_map(get, tree)
 
 
 def finish_device_run(
@@ -196,7 +216,7 @@ def finish_device_run(
     counts — the packed closure stays device-resident until someone reads
     it (``SaturationResult._fetch``)."""
     sp, rp = out[0], out[1]
-    it, changed, bits, init_bits = jax.device_get(out[2:])
+    it, changed, bits, init_bits = fetch_global(out[2:])
     it, changed = np.max(it), np.max(changed)
     converged = not bool(changed)
     if not converged and not allow_incomplete:
@@ -502,7 +522,7 @@ class SaturationEngine:
             # shapes already match — copy so donation can't delete them
             s, r = self.embed_state(*initial)
             s, r = jnp.array(s, copy=True), jnp.array(r, copy=True)
-        init_total = _host_bit_total(jax.device_get(self._live_bits(s, r)))
+        init_total = _host_bit_total(fetch_global(self._live_bits(s, r)))
         budget = _pad_up(max_iters, self.unroll)
         s, r, iteration, total, converged = observed_loop(
             self._observe_jit, s, r, init_total, self.unroll, budget, observer
@@ -540,7 +560,7 @@ class SaturationEngine:
         # exactly one host sync for the whole run — scalars and per-row
         # counts only; the packed closure stays on device until someone
         # actually reads it (SaturationResult._fetch)
-        iteration, changed, bits, init_bits = jax.device_get(
+        iteration, changed, bits, init_bits = fetch_global(
             (out.iteration, out.changed, out.bits, init_bits)
         )
         derivations = _host_bit_total(bits) - _host_bit_total(init_bits)

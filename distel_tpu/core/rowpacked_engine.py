@@ -69,6 +69,7 @@ from distel_tpu.core.engine import (
     SaturationResult,
     _host_bit_total,
     _pad_up,
+    fetch_global,
     finish_device_run,
     observed_loop,
 )
@@ -715,7 +716,7 @@ class RowPackedSaturationEngine:
         if self._live_bits_jit is None:
             self._live_bits_jit = jax.jit(self._live_bits)
         init_total = _host_bit_total(
-            jax.device_get(self._live_bits_jit(sp, rp))
+            fetch_global(self._live_bits_jit(sp, rp))
         )
         budget = _pad_up(max_iters, self.unroll)
         sp, rp, iteration, total, converged = observed_loop(

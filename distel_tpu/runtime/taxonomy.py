@@ -35,7 +35,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from distel_tpu.core.engine import SaturationResult
+from distel_tpu.core.engine import SaturationResult, fetch_global
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID
 
 #: max direct parents per class the device path transfers; beyond this it
@@ -262,7 +262,7 @@ def _extract_device(result, orig, names) -> Optional[Taxonomy]:
         bool(result.transposed),
         _PARENT_CAP,
     )
-    canon, unsat, counts, pidx = jax.device_get(run(result.packed_s))
+    canon, unsat, counts, pidx = fetch_global(run(result.packed_s))
     return _assemble(orig, names, canon, unsat, counts, pidx)
 
 
@@ -405,7 +405,7 @@ def _extract_device_blocked(result, orig, names) -> Optional[Taxonomy]:
         _PARENT_CAP,
         _TAX_BLOCK,
     )
-    canon, unsat, counts, pidx = jax.device_get(run(result.packed_s))
+    canon, unsat, counts, pidx = fetch_global(run(result.packed_s))
     return _assemble(orig, names, canon, unsat, counts, pidx)
 
 

@@ -1,0 +1,54 @@
+"""Worker process for test_multihost.py: join the multi-controller
+runtime through the framework's own bootstrap, run the sharded fixed
+point over the global mesh, print a result line the test asserts on.
+
+Run as: python tests/_multihost_worker.py <coordinator> <pid> <nproc>
+with JAX_PLATFORMS=cpu and xla_force_host_platform_device_count set by
+the spawner.
+"""
+
+import sys
+
+coordinator, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from distel_tpu.parallel.mesh import build_mesh, init_distributed  # noqa: E402
+
+init_distributed(coordinator, nproc, pid)
+
+import jax  # noqa: E402
+
+assert jax.process_count() == nproc, jax.process_count()
+mesh = build_mesh()
+
+from distel_tpu.core.indexing import index_ontology  # noqa: E402
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine  # noqa: E402
+from distel_tpu.frontend.normalizer import normalize  # noqa: E402
+from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology  # noqa: E402
+from distel_tpu.owl import parser  # noqa: E402
+
+text = snomed_shaped_ontology(n_classes=400, n_roles=24)
+idx = index_ontology(normalize(parser.parse(text)))
+res = RowPackedSaturationEngine(idx, mesh=mesh).saturate()
+
+# full-closure comparison, not just counts: res.s goes through the
+# collective allgather fetch (every process participates), and proc 0
+# diffs it bit-for-bit against an independent single-process run
+import hashlib  # noqa: E402
+
+n, nl = idx.n_concepts, idx.n_links
+mesh_closure = (res.s[:n, :n].tobytes(), res.r[:n, :nl].tobytes())
+digest = hashlib.sha256(mesh_closure[0] + mesh_closure[1]).hexdigest()[:16]
+closure_match = "n/a"
+if pid == 0:
+    local = RowPackedSaturationEngine(idx).saturate()
+    closure_match = bool(
+        local.derivations == res.derivations
+        and local.s[:n, :n].tobytes() == mesh_closure[0]
+        and local.r[:n, :nl].tobytes() == mesh_closure[1]
+    )
+print(
+    f"MULTIHOST pid={pid} shards={mesh.shape['c']} "
+    f"derivations={res.derivations} digest={digest} "
+    f"closure_match={closure_match}",
+    flush=True,
+)

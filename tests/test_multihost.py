@@ -1,0 +1,65 @@
+"""Multi-controller (multi-host) execution: two OS processes, four
+virtual CPU devices each, one global 8-device mesh — the DCN-scale
+analog of the reference's pssh-fanned node fleet
+(``scripts/classify-all.sh``), with ``jax.distributed`` playing the
+role of the Redis channel host.  The sharded fixed point must produce
+the same closure as a single process."""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_matches_single_process():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PALLAS_AXON_POOL_IPS="",
+        PYTHONPATH=_REPO,
+    )
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coordinator, str(pid), "2"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=500)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    lines = [
+        ln
+        for out in outs
+        for ln in out.splitlines()
+        if ln.startswith("MULTIHOST")
+    ]
+    assert len(lines) == 2, f"worker output:\n{outs[0]}\n----\n{outs[1]}"
+    assert all("shards=8" in ln for ln in lines), lines
+    derivs = {ln.split("derivations=")[1].split()[0] for ln in lines}
+    assert len(derivs) == 1, lines
+    digests = {ln.split("digest=")[1].split()[0] for ln in lines}
+    assert len(digests) == 1, lines  # both processes fetched the same closure
+    assert any("closure_match=True" in ln for ln in lines), lines
+    assert all(p.returncode == 0 for p in procs), [p.returncode for p in procs]
