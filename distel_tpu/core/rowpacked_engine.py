@@ -106,13 +106,17 @@ class RowPackedSaturationEngine:
         rules: Optional[frozenset] = None,
         mm_opts: Optional[dict] = None,
         l_chunk: Optional[int] = None,
+        gate_chunks: Optional[bool] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
         another backend (``core/hybrid.py``) are excluded here.
         ``mm_opts``: extra keyword overrides for the CR4/CR6
         :class:`PackedColsMatmulPlan` (tiling, ``skip_zero_tiles``,
-        ``interpret``) — the test hook for pinning a kernel variant."""
+        ``interpret``) — the test hook for pinning a kernel variant.
+        ``gate_chunks``: frontier-gated chunk skipping (None = auto,
+        enabled at ≥32k concepts where skipped work outweighs the
+        per-chunk branch)."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
@@ -294,6 +298,10 @@ class RowPackedSaturationEngine:
             wmask[full] = (1 << rem) - 1
         self._wmask = wmask
 
+        if gate_chunks is None:
+            gate_chunks = self.nc >= 32_768
+        self._gate = self._build_gate() if gate_chunks else None
+
         if mesh is not None:
             P = jax.sharding.PartitionSpec
             self._state_sharding = jax.sharding.NamedSharding(
@@ -417,17 +425,24 @@ class RowPackedSaturationEngine:
 
     # ------------------------------------------------------------- rules
 
-    def _shard_jit(self, fn, out_specs, donate=()):
+    def _shard_jit(self, fn, out_specs, donate=(), with_dirty=False):
         """Shared shard_map+jit scaffolding for every mesh entry point
         (fixed point, public step, observed round): state sharded on the
-        packed word axis, masks replicated."""
+        packed word axis, masks replicated; ``with_dirty`` adds a
+        replicated frontier-flag vector between state and masks."""
         P = jax.sharding.PartitionSpec
         state = P(None, self.word_axis)
+        masks = (P(None, None), P(None, None))
+        in_specs = (
+            (state, state, P(None), masks)
+            if with_dirty
+            else (state, state, masks)
+        )
         return jax.jit(
             jax.shard_map(
                 fn,
                 mesh=self.mesh,
-                in_specs=(state, state, (P(None, None), P(None, None))),
+                in_specs=in_specs,
                 out_specs=out_specs,
                 check_vma=False,
             ),
@@ -455,34 +470,197 @@ class RowPackedSaturationEngine:
         bits = bit_lookup(p, rows, cols, word_offset=base, dtype=jnp.int32)
         return lax.psum(bits, axis_name).astype(dt)
 
+    def _build_gate(self):
+        """Static structures for frontier-gated chunk skipping — the
+        tensor analog of the reference's semi-naive score cursors
+        (``misc/Util.java:68-93``: every worker re-reads only keys whose
+        score grew): a rule chunk re-runs only when a row it reads
+        changed in the previous superstep.  Writers emit per-target
+        change vectors; *layered permutation gathers* turn them into
+        global changed-row masks (a scatter would serialize per index on
+        TPU); each reader's dirty flag is then a static gather + any().
+        CR4/CR6 contract over the whole R matrix, so any R change
+        re-dirties them.  Flag order == chunk execution order in
+        :meth:`_step`."""
+        s_writers, r_writers, readers = [], [], []
+        for sl, plan in self._cr1_chunks:
+            s_writers.append(plan.targets)
+            readers.append(("S", np.unique(self._src1[sl])))
+        for sl, plan in self._cr2_chunks:
+            s_writers.append(plan.targets)
+            readers.append(
+                ("S", np.unique(np.r_[self._src2a[sl], self._src2b[sl]]))
+            )
+        for sl, plan in self._cr3_chunks:
+            r_writers.append(plan.targets)
+            readers.append(("S", np.unique(self._src3[sl])))
+        for raw, _inv, plan in self._cr4_chunks:
+            s_writers.append(plan.targets)
+            readers.append(("SR", np.unique(self._a4[raw])))
+        for raw, _inv, plan in self._cr6_chunks:
+            r_writers.append(plan.targets)
+            readers.append(("RR", None))
+        if self._bottom:
+            s_writers.append(np.asarray([BOTTOM_ID]))
+            readers.append(("CR5", None))
+
+        def pos_maps(writers, n_rows):
+            """Layered row → concat-position maps; position ``sentinel``
+            indexes a trailing always-False slot.  Rows written by k
+            writers occupy k layers (k ≤ number of S-writing rules)."""
+            offs = np.cumsum([0] + [len(t) for t in writers])
+            sentinel = int(offs[-1])  # trailing always-False concat slot
+            if not writers or n_rows == 0:
+                return []
+            mult = np.zeros(n_rows, np.int64)
+            for t in writers:
+                mult[t] += 1
+            n_layers = int(mult.max()) if len(mult) else 0
+            layers = [
+                np.full(n_rows, sentinel, np.int64) for _ in range(n_layers)
+            ]
+            level = np.zeros(n_rows, np.int64)
+            for w, t in enumerate(writers):
+                pos = offs[w] + np.arange(len(t))
+                lv = level[t]
+                for li in range(n_layers):
+                    sel = lv == li
+                    if sel.any():
+                        layers[li][t[sel]] = pos[sel]
+                level[t] += 1
+            return layers
+
+        # R-side masks are unnecessary: every R reader (CR4/CR6 contract
+        # the whole matrix, CR5 reduces it) re-dirties on ANY R change,
+        # so the R writers only feed the concatenated any() below
+        s_layers = pos_maps(s_writers, self.nc)
+        if not readers:
+            return None
+        return {
+            "readers": readers,
+            "s_layers": s_layers,
+            "n_flags": len(readers),
+        }
+
+    def initial_dirty(self) -> jax.Array:
+        """All-dirty flags (every chunk runs on the first superstep)."""
+        n = self._gate["n_flags"] if self._gate else 0
+        return jnp.ones(max(n, 1), bool)
+
+    def _next_dirty(self, s_vecs, r_vecs, axis_name):
+        """End-of-step flag computation from the writers' change
+        vectors; one tiny psum makes the flags globally uniform under
+        sharding (the cond predicates must agree across shards)."""
+        g = self._gate
+        cs = jnp.concatenate(
+            [v.astype(bool) for v in s_vecs] + [jnp.zeros(1, bool)]
+        )
+        cr = jnp.concatenate(
+            [v.astype(bool) for v in r_vecs] + [jnp.zeros(1, bool)]
+        )
+        mask_s = None
+        for pm in g["s_layers"]:
+            got = cs[jnp.asarray(pm)]
+            mask_s = got if mask_s is None else (mask_s | got)
+        any_r = jnp.any(cr)
+        flags = []
+        for kind, rows in g["readers"]:
+            if kind == "S":
+                d = (
+                    jnp.any(mask_s[jnp.asarray(rows)])
+                    if mask_s is not None and rows.size
+                    else jnp.asarray(False)
+                )
+            elif kind == "SR":
+                d = any_r
+                if mask_s is not None and rows.size:
+                    d = d | jnp.any(mask_s[jnp.asarray(rows)])
+            elif kind == "RR":
+                d = any_r
+            else:  # CR5
+                d = any_r
+                if mask_s is not None:
+                    d = d | mask_s[BOTTOM_ID]
+            flags.append(d)
+        dirty = jnp.stack(flags)
+        if axis_name is not None:
+            dirty = lax.psum(dirty.astype(jnp.int32), axis_name) > 0
+        return dirty
+
     def _step(
         self,
         sp: jax.Array,
         rp: jax.Array,
         masks: Optional[Tuple[jax.Array, jax.Array]] = None,
         axis_name: Optional[str] = None,
-    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        """One superstep → (sp, rp, changed).  ``changed`` is tracked at
+        dirty: Optional[jax.Array] = None,
+    ):
+        """One superstep → ``(sp, rp, changed)``, or with ``dirty``
+        (frontier flags, see :meth:`_build_gate`) →
+        ``(sp, rp, changed, dirty_next)``.  ``changed`` is tracked at
         each rule's write (on the touched rows only) rather than by a
         whole-array post-comparison, so the pre-step state is dead as
         soon as the last rule reads it — without this the fixed-point
         loop carries two full copies of S and OOMs ~2x earlier."""
         m4, m6 = self._masks if masks is None else masks
+        gating = dirty is not None and self._gate is not None
         ch = jnp.asarray(False)
+        s_vecs, r_vecs = [], []
+        flag = iter(range(self._gate["n_flags"])) if gating else None
+
+        def gated(n_targets, operand, do, keep):
+            """Run ``do(operand) → (written-state, rowwise-change)``
+            under this chunk's dirty flag; a skipped chunk forwards
+            ``keep(operand)`` (the written state, untouched) with a zero
+            change vector.  The one cond-skip protocol every rule chunk
+            shares — the flag iterator consumes indices in
+            ``_build_gate``'s reader order."""
+            if not gating:
+                return do(operand)
+            return lax.cond(
+                dirty[next(flag)],
+                do,
+                lambda ops: (keep(ops), jnp.zeros(n_targets, bool)),
+                operand,
+            )
+
         # CR1: a ⊑ b
         for sl, plan in self._cr1_chunks:
-            sp, c = plan.apply(sp, sp[self._src1[sl]], track=True)
-            ch |= c
+            sp, cv = gated(
+                plan.n_targets,
+                sp,
+                lambda s, sl=sl, plan=plan: plan.apply(
+                    s, s[self._src1[sl]], track="rows"
+                ),
+                lambda s: s,
+            )
+            s_vecs.append(cv)
+            ch |= jnp.any(cv)
         # CR2: a1 ⊓ a2 ⊑ b
         for sl, plan in self._cr2_chunks:
-            sp, c = plan.apply(
-                sp, sp[self._src2a[sl]] & sp[self._src2b[sl]], track=True
+            sp, cv = gated(
+                plan.n_targets,
+                sp,
+                lambda s, sl=sl, plan=plan: plan.apply(
+                    s, s[self._src2a[sl]] & s[self._src2b[sl]], track="rows"
+                ),
+                lambda s: s,
             )
-            ch |= c
-        # CR3: a ⊑ ∃link
+            s_vecs.append(cv)
+            ch |= jnp.any(cv)
+        # CR3: a ⊑ ∃link — reads S, writes R: the cond operand carries
+        # both, the skip branch forwards R untouched
         for sl, plan in self._cr3_chunks:
-            rp, c = plan.apply(rp, sp[self._src3[sl]], track=True)
-            ch |= c
+            rp, cv = gated(
+                plan.n_targets,
+                (sp, rp),
+                lambda ops, sl=sl, plan=plan: plan.apply(
+                    ops[1], ops[0][self._src3[sl]], track="rows"
+                ),
+                lambda ops: ops[1],
+            )
+            r_vecs.append(cv)
+            ch |= jnp.any(cv)
         # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
         # HBM end to end (the Pallas kernel unpacks/repacks per VMEM tile;
         # the XLA fallback materializes the wide operands instead).  The
@@ -505,9 +683,9 @@ class RowPackedSaturationEngine:
             else lax.axis_index(axis_name) * (self.wc // self.n_shards)
         )
 
-        def contract(state_for_bits, rows, mask_rows, mm):
+        def contract_from(bits_state, rp_state, rows, mask_rows, mm):
             rk = len(rows)
-            subt = state_for_bits[jnp.asarray(rows)].T    # [W, rk], hoisted
+            subt = bits_state[jnp.asarray(rows)].T        # [W, rk], hoisted
 
             def one(i, acc):
                 if axis_name is None:
@@ -524,7 +702,7 @@ class RowPackedSaturationEngine:
                     mask_rows, (0, i * (lc // 32)), (rk, lc // 32)
                 )
                 w = unpack_words(mw, lc, dtype=dt) * f.T  # [rk, lc]
-                b = lax.dynamic_slice(rp, (i * lc, 0), (lc, wlw))
+                b = lax.dynamic_slice(rp_state, (i * lc, 0), (lc, wlw))
                 return acc | mm(w, b)
 
             if self.n_lchunks == 1:
@@ -535,27 +713,51 @@ class RowPackedSaturationEngine:
 
         if self._p4 is not None:
             for (raw, inv, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
-                out = contract(sp, self._a4[raw], m4[raw], mm)
-                sp, c = plan.apply(sp, out[inv], track=True)
-                ch |= c
+
+                def do4(ops, raw=raw, inv=inv, plan=plan, mm=mm):
+                    s, r = ops
+                    out = contract_from(s, r, self._a4[raw], m4[raw], mm)
+                    return plan.apply(s, out[inv], track="rows")
+
+                sp, cv = gated(
+                    plan.n_targets, (sp, rp), do4, lambda ops: ops[0]
+                )
+                s_vecs.append(cv)
+                ch |= jnp.any(cv)
         # CR6: role chains
         if self._p6 is not None:
             for (raw, inv, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
-                out = contract(rp, self._l26[raw], m6[raw], mm)
-                rp, c = plan.apply(rp, out[inv], track=True)
-                ch |= c
+
+                def do6(r, raw=raw, inv=inv, plan=plan, mm=mm):
+                    out = contract_from(r, r, self._l26[raw], m6[raw], mm)
+                    return plan.apply(r, out[inv], track="rows")
+
+                rp, cv = gated(plan.n_targets, rp, do6, lambda r: r)
+                r_vecs.append(cv)
+                ch |= jnp.any(cv)
         # CR5: ⊥ back-propagation — one masked packed OR-reduce
         if self._bottom:
-            botf = self._bit_table(sp, np.full(1, BOTTOM_ID), axis_name)
-            mask = botf[:, 0].astype(bool)                  # [nl]
-            masked = jnp.where(mask[:, None], rp, jnp.asarray(0, jnp.uint32))
-            newrow = lax.reduce(
-                masked, np.uint32(0), lax.bitwise_or, (0,)
-            )
-            old = sp[BOTTOM_ID]
-            merged = old | newrow
-            ch |= jnp.any(merged != old)
-            sp = sp.at[BOTTOM_ID].set(merged)
+
+            def do5(ops):
+                s, r = ops
+                botf = self._bit_table(s, np.full(1, BOTTOM_ID), axis_name)
+                mask = botf[:, 0].astype(bool)              # [nl]
+                masked = jnp.where(
+                    mask[:, None], r, jnp.asarray(0, jnp.uint32)
+                )
+                newrow = lax.reduce(masked, np.uint32(0), lax.bitwise_or, (0,))
+                old = s[BOTTOM_ID]
+                merged = old | newrow
+                return (
+                    s.at[BOTTOM_ID].set(merged),
+                    jnp.any(merged != old)[None],
+                )
+
+            sp, cv = gated(1, (sp, rp), do5, lambda ops: ops[0])
+            s_vecs.append(cv)
+            ch |= jnp.any(cv)
+        if gating:
+            return sp, rp, ch, self._next_dirty(s_vecs, r_vecs, axis_name)
         return sp, rp, ch
 
     def step(self, sp, rp):
@@ -599,26 +801,39 @@ class RowPackedSaturationEngine:
         axis_name: Optional[str] = None,
     ):
         unroll = self.unroll
+        gating = self._gate is not None
 
         def cond(st):
-            sp, rp, it, changed = st
-            return changed & (it < max_iters)
+            return st[3] & (st[2] < max_iters)
 
         def body(st):
-            sp, rp, it, _ = st
+            sp, rp, it, _, dirty = st
             changed = jnp.asarray(False)
             for _ in range(unroll):
-                sp, rp, c = self._step(sp, rp, masks, axis_name)
+                if gating:
+                    sp, rp, c, dirty = self._step(
+                        sp, rp, masks, axis_name, dirty
+                    )
+                else:
+                    sp, rp, c = self._step(sp, rp, masks, axis_name)
                 changed |= c
             if axis_name is not None:
                 # the reference's global AND-vote
                 # (controller/CommunicationHandler.java:78-83) as one psum
                 changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
-            return (sp, rp, it + unroll, changed)
+            return (sp, rp, it + unroll, changed, dirty)
 
         init_bits = self._live_bits(sp0, rp0, axis_name)
-        sp, rp, it, changed = lax.while_loop(
-            cond, body, (sp0, rp0, jnp.asarray(0, jnp.int32), jnp.asarray(True))
+        sp, rp, it, changed, _d = lax.while_loop(
+            cond,
+            body,
+            (
+                sp0,
+                rp0,
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(True),
+                self.initial_dirty(),
+            ),
         )
         return sp, rp, it, changed, self._live_bits(sp, rp, axis_name), init_bits
 
@@ -649,14 +864,17 @@ class RowPackedSaturationEngine:
             donate=(0, 1),
         )
 
-    def _observe_round(self, sp, rp, masks, axis_name=None):
+    def _observe_round(self, sp, rp, dirty, masks, axis_name=None):
         changed = jnp.asarray(False)
         for _ in range(self.unroll):
-            sp, rp, c = self._step(sp, rp, masks, axis_name)
+            if self._gate is not None:
+                sp, rp, c, dirty = self._step(sp, rp, masks, axis_name, dirty)
+            else:
+                sp, rp, c = self._step(sp, rp, masks, axis_name)
             changed |= c
         if axis_name is not None:
             changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
-        return sp, rp, changed, self._live_bits(sp, rp, axis_name)
+        return sp, rp, changed, self._live_bits(sp, rp, axis_name), dirty
 
     def saturate_observed(
         self,
@@ -683,13 +901,14 @@ class RowPackedSaturationEngine:
                 P = jax.sharding.PartitionSpec
                 axis = self.word_axis
 
-                def fn(sp, rp, masks):
-                    sp, rp, ch, bits = self._observe_round(
-                        sp, rp, masks, axis
+                def fn(sp, rp, dirty, masks):
+                    sp, rp, ch, bits, dirty = self._observe_round(
+                        sp, rp, dirty, masks, axis
                     )
                     # scalar leaves as one lane per shard (replicated by
-                    # the psum); bits leave as per-shard partials
-                    return sp, rp, ch[None], bits
+                    # the psum); bits leave as per-shard partials; dirty
+                    # is replicated (psum'd inside the step)
+                    return sp, rp, ch[None], bits, dirty
 
                 inner = self._shard_jit(
                     fn,
@@ -698,13 +917,15 @@ class RowPackedSaturationEngine:
                         P(None, axis),
                         P(axis),
                         P(axis),
+                        P(None),
                     ),
                     donate=(0, 1),
+                    with_dirty=True,
                 )
 
-                def observe(sp, rp, masks):
-                    sp, rp, lanes, bits = inner(sp, rp, masks)
-                    return sp, rp, lanes.max(), bits
+                def observe(sp, rp, dirty, masks):
+                    sp, rp, lanes, bits, dirty = inner(sp, rp, dirty, masks)
+                    return sp, rp, lanes.max(), bits, dirty
 
                 self._observe_jit = observe
         if initial is None:
@@ -719,8 +940,16 @@ class RowPackedSaturationEngine:
             fetch_global(self._live_bits_jit(sp, rp))
         )
         budget = _pad_up(max_iters, self.unroll)
+        dirty_box = [self.initial_dirty()]
+
+        def observe_step(s, r):
+            s, r, ch, bits, dirty_box[0] = self._observe_jit(
+                s, r, dirty_box[0], self._masks
+            )
+            return s, r, ch, bits
+
         sp, rp, iteration, total, converged = observed_loop(
-            lambda s, r: self._observe_jit(s, r, self._masks),
+            observe_step,
             sp, rp, init_total, self.unroll, budget, observer,
         )
         if not converged and not allow_incomplete:

@@ -280,20 +280,26 @@ class SegmentedRowOr:
                 outs.append(lax.reduce(chunk, zero, lax.bitwise_or, (1,)))
         return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
 
-    def apply(self, state, rows, track: bool = False):
+    def apply(self, state, rows, track=False):
         """OR ``rows`` [k, W] (gathered through ``order``) into ``state``
         [N, W] at this plan's target rows.  ``track=True`` additionally
-        returns a scalar "did any bit change" — computed on the touched
-        rows only, so the caller never needs to keep the pre-step state
-        alive for a whole-array comparison (which doubles state memory
-        inside the fixed-point loop)."""
+        returns a scalar "did any bit change"; ``track="rows"`` returns
+        the per-target change vector [n_targets] bool instead (the
+        frontier signal for chunk gating).  Either way the change is
+        computed on the touched rows only, so the caller never needs to
+        keep the pre-step state alive for a whole-array comparison
+        (which doubles state memory inside the fixed-point loop)."""
         if self.k == 0:
+            if track == "rows":
+                return state, jnp.zeros(0, bool)
             return (state, jnp.asarray(False)) if track else state
         state = jnp.asarray(state)
         t = jnp.asarray(self.targets)
         old = state[t]
         merged = old | self.reduce(rows)
         out = state.at[t].set(merged)
+        if track == "rows":
+            return out, jnp.any(merged != old, axis=1)
         if track:
             return out, jnp.any(merged != old)
         return out
