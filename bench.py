@@ -69,6 +69,17 @@ def main() -> None:
     )
     engine_dps = result.derivations / warm_s
 
+    # measured tunnel round-trip (a trivial device call), so readers can
+    # tell when a warm number is latency- rather than compute-dominated
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1)
+    tiny(jnp.zeros(8)).block_until_ready()
+    rtt_s = min(
+        _timed(lambda: tiny(jnp.zeros(8)).block_until_ready())
+        for _ in range(5)
+    )
+
     # CPU reference baseline on the same corpus
     t0 = time.time()
     oracle_result = cpu_oracle.saturate(norm)
@@ -106,6 +117,7 @@ def main() -> None:
                 "iterations": result.iterations,
                 "wall_s_warm": round(warm_s, 3),
                 "wall_s_cold": round(cold_s, 3),
+                "rtt_s": round(rtt_s, 3),
                 "baseline_cpu_dps": round(oracle_dps, 1),
                 **snomed_fields,
             }

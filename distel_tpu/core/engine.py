@@ -191,7 +191,17 @@ def fetch_global(tree):
     with ``process_allgather``, which is a collective — every process
     must call this on the same values, which they do (SPMD epilogue).
     The fallback is selected per leaf by addressability, so unrelated
-    ``RuntimeError``s (e.g. a donated buffer) surface unchanged."""
+    ``RuntimeError``s (e.g. a donated buffer) surface unchanged; when
+    every leaf is local the whole tree goes through ONE ``device_get``
+    (a per-leaf loop costs one tunnel round trip per leaf on
+    remote-attached devices — measured 4x the warm fixed-point wall
+    time at small scale)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if all(
+        not isinstance(x, jax.Array) or x.is_fully_addressable
+        for x in leaves
+    ):
+        return jax.device_get(tree)
 
     def get(x):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:

@@ -595,15 +595,18 @@ class RowPackedSaturationEngine:
         axis_name: Optional[str] = None,
         dirty: Optional[jax.Array] = None,
     ):
-        """One superstep → ``(sp, rp, changed)``, or with ``dirty``
-        (frontier flags, see :meth:`_build_gate`) →
-        ``(sp, rp, changed, dirty_next)``.  ``changed`` is tracked at
+        """One superstep → ``(sp, rp, changed, dirty_next)`` —
+        ``dirty``/``dirty_next`` are the frontier flags (see
+        :meth:`_build_gate`; passed through untouched, possibly ``None``,
+        when gating is off).  ``changed`` is tracked at
         each rule's write (on the touched rows only) rather than by a
         whole-array post-comparison, so the pre-step state is dead as
         soon as the last rule reads it — without this the fixed-point
         loop carries two full copies of S and OOMs ~2x earlier."""
         m4, m6 = self._masks if masks is None else masks
-        gating = dirty is not None and self._gate is not None
+        gating = self._gate is not None
+        if gating and dirty is None:  # stateless public step(): all-dirty
+            dirty = self.initial_dirty()
         ch = jnp.asarray(False)
         s_vecs, r_vecs = [], []
         flag = iter(range(self._gate["n_flags"])) if gating else None
@@ -757,8 +760,8 @@ class RowPackedSaturationEngine:
             s_vecs.append(cv)
             ch |= jnp.any(cv)
         if gating:
-            return sp, rp, ch, self._next_dirty(s_vecs, r_vecs, axis_name)
-        return sp, rp, ch
+            dirty = self._next_dirty(s_vecs, r_vecs, axis_name)
+        return sp, rp, ch, dirty
 
     def step(self, sp, rp):
         """One superstep.  On a mesh engine the matmul plans are sized to
@@ -801,7 +804,6 @@ class RowPackedSaturationEngine:
         axis_name: Optional[str] = None,
     ):
         unroll = self.unroll
-        gating = self._gate is not None
 
         def cond(st):
             return st[3] & (st[2] < max_iters)
@@ -810,12 +812,7 @@ class RowPackedSaturationEngine:
             sp, rp, it, _, dirty = st
             changed = jnp.asarray(False)
             for _ in range(unroll):
-                if gating:
-                    sp, rp, c, dirty = self._step(
-                        sp, rp, masks, axis_name, dirty
-                    )
-                else:
-                    sp, rp, c = self._step(sp, rp, masks, axis_name)
+                sp, rp, c, dirty = self._step(sp, rp, masks, axis_name, dirty)
                 changed |= c
             if axis_name is not None:
                 # the reference's global AND-vote
@@ -867,10 +864,7 @@ class RowPackedSaturationEngine:
     def _observe_round(self, sp, rp, dirty, masks, axis_name=None):
         changed = jnp.asarray(False)
         for _ in range(self.unroll):
-            if self._gate is not None:
-                sp, rp, c, dirty = self._step(sp, rp, masks, axis_name, dirty)
-            else:
-                sp, rp, c = self._step(sp, rp, masks, axis_name)
+            sp, rp, c, dirty = self._step(sp, rp, masks, axis_name, dirty)
             changed |= c
         if axis_name is not None:
             changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
