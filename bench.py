@@ -80,9 +80,13 @@ def main() -> None:
         for _ in range(5)
     )
 
-    # CPU reference baseline on the same corpus
+    # CPU reference baseline on the same corpus — time-bounded: the
+    # sequential oracle takes minutes at this size, and its throughput
+    # only FALLS as saturation proceeds (early iterations derive the
+    # cheap bulk), so a budget-capped derivations/s reads in the
+    # baseline's favor while keeping the bench bounded
     t0 = time.time()
-    oracle_result = cpu_oracle.saturate(norm)
+    oracle_result = cpu_oracle.saturate(norm, time_budget_s=90.0)
     oracle_s = time.time() - t0
     oracle_dps = oracle_result.derivation_count() / oracle_s
 
@@ -119,6 +123,8 @@ def main() -> None:
                 "wall_s_cold": round(cold_s, 3),
                 "rtt_s": round(rtt_s, 3),
                 "baseline_cpu_dps": round(oracle_dps, 1),
+                "baseline_budget_s": 90.0,
+                "baseline_converged": oracle_result.converged,
                 **snomed_fields,
             }
         )

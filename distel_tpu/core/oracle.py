@@ -21,7 +21,8 @@ Rules (S(X) = subsumer set, R(r) = role pairs):
 
 from __future__ import annotations
 
-from typing import Dict, Set, Tuple
+import time
+from typing import Dict, Optional, Set, Tuple
 
 from distel_tpu.frontend.normalizer import NormalizedOntology
 from distel_tpu.owl import syntax as S
@@ -31,9 +32,12 @@ Role = S.ObjectProperty
 
 
 class OracleResult:
-    def __init__(self, subsumers: Dict[Atom, Set[Atom]], role_pairs):
+    def __init__(
+        self, subsumers: Dict[Atom, Set[Atom]], role_pairs, converged=True
+    ):
         self.subsumers = subsumers
         self.role_pairs = role_pairs
+        self.converged = converged
 
     def is_subsumed(self, sub: Atom, sup: Atom) -> bool:
         sups = self.subsumers.get(sub, set())
@@ -51,7 +55,15 @@ class OracleResult:
         )
 
 
-def saturate(norm: NormalizedOntology, max_iters: int = 10_000) -> OracleResult:
+def saturate(
+    norm: NormalizedOntology,
+    max_iters: int = 10_000,
+    time_budget_s: Optional[float] = None,
+) -> OracleResult:
+    """``time_budget_s`` stops after the first iteration that exceeds
+    the budget, returning the partial (sound, possibly incomplete)
+    result with ``converged=False`` — for bounded baseline throughput
+    measurements (bench.py); correctness consumers must leave it None."""
     universe = set(norm.atoms())
     universe.add(S.OWL_THING)
     universe.add(S.OWL_NOTHING)
@@ -65,6 +77,9 @@ def saturate(norm: NormalizedOntology, max_iters: int = 10_000) -> OracleResult:
     def size() -> int:
         return sum(len(v) for v in inv.values()) + sum(len(v) for v in rp.values())
 
+    deadline = (
+        time.monotonic() + time_budget_s if time_budget_s is not None else None
+    )
     prev = -1
     iters = 0
     while size() != prev:
@@ -114,6 +129,8 @@ def saturate(norm: NormalizedOntology, max_iters: int = 10_000) -> OracleResult:
             for (x, y) in list(rs):
                 for z in by_first.get(y, ()):
                     tgt.add((x, z))
+        if deadline is not None and time.monotonic() > deadline:
+            break
 
     # invert back to direct S(X) form (reference ResultRearranger,
     # `test/ResultRearranger.java:57-105`)
@@ -121,4 +138,5 @@ def saturate(norm: NormalizedOntology, max_iters: int = 10_000) -> OracleResult:
     for a, xs in inv.items():
         for x in xs:
             subs.setdefault(x, set()).add(a)
-    return OracleResult(subs, rp)
+    converged = size() == prev
+    return OracleResult(subs, rp, converged)

@@ -129,3 +129,27 @@ def test_top_axiom():
     assert r.is_subsumed(C("B"), C("A"))
     assert r.is_subsumed(C("D"), C("A"))
     assert r.is_subsumed(C("A"), C("A"))
+
+
+def test_oracle_time_budget_partial_is_sound():
+    """A budget-capped oracle run returns a sound subset of the full
+    closure (bench.py uses this for bounded baseline throughput)."""
+    from distel_tpu.core import oracle
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import synthetic_ontology
+    from distel_tpu.owl import parser
+
+    norm = normalize(
+        parser.parse(
+            synthetic_ontology(
+                n_classes=400, n_anatomy=60, n_locations=40, n_definitions=25
+            )
+        )
+    )
+    full = oracle.saturate(norm)
+    assert full.converged
+    partial = oracle.saturate(norm, time_budget_s=0.0)
+    assert not partial.converged
+    for x, sups in partial.subsumers.items():
+        assert sups <= full.subsumers.get(x, set())
+    assert partial.derivation_count() < full.derivation_count()
