@@ -35,7 +35,9 @@ def cmd_classify(args) -> int:
         cfg.mesh_devices = args.mesh
     cfg.instrumentation = args.instrument
     clf = ELClassifier(cfg)
-    res = clf.classify_file(args.ontology, verify=args.verify)
+    res = clf.classify_file(
+        args.ontology, verify=args.verify, resume_from=args.resume
+    )
     print(json.dumps(res.summary(), indent=2))
     if args.output:
         res.taxonomy.write(args.output)
@@ -244,6 +246,14 @@ def main(argv=None) -> int:
     c.add_argument("--mesh", type=int, help="devices on the concept axis")
     c.add_argument("--output", "-o", help="write taxonomy here")
     c.add_argument("--snapshot", help="write S/R snapshot (.npz)")
+    c.add_argument(
+        "--resume",
+        help=(
+            "warm-start from a snapshot (.npz), realigned by name; the "
+            "snapshot's corpus must be a SUBSET of this one (saturation "
+            "is monotone — retracted axioms' consequences would survive)"
+        ),
+    )
     c.add_argument("--verify", action="store_true", help="diff vs CPU oracle")
     c.add_argument("--instrument", action="store_true", help="phase timers")
     c.set_defaults(fn=cmd_classify)

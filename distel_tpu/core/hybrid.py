@@ -92,6 +92,9 @@ def apply_rules_host(
 
 
 class HybridSaturator:
+    #: delegates embedding to the row-packed engine
+    accepts_wire_state = True
+
     """Saturates with the TPU engine applying ``tpu_rules`` and the host
     applying ``host_rules``, alternating to a global fixed point.  API
     matches the engines' ``saturate``."""

@@ -89,6 +89,10 @@ class RowPackedSaturationEngine:
     ``initial_state`` / ``step`` / ``saturate`` / ``embed_state``; pass
     ``mesh=`` to shard the packed word axis (see module docstring)."""
 
+    #: this engine's embed_state understands the wire-packed (transposed
+    #: uint32) snapshot form — see runtime/checkpoint.load_snapshot_state
+    accepts_wire_state = True
+
     def __init__(
         self,
         idx: IndexedOntology,

@@ -112,7 +112,21 @@ class ELClassifier:
 
     # ------------------------------------------------------------------
 
-    def classify_text(self, text: str, *, verify: bool = False) -> ClassificationResult:
+    def classify_text(
+        self,
+        text: str,
+        *,
+        verify: bool = False,
+        resume_from: Optional[str] = None,
+    ) -> ClassificationResult:
+        """``resume_from``: path of a snapshot (``checkpoint.save_snapshot``)
+        to warm-start saturation from — the reference's Redis-RDB-reload
+        scenario.  The state is realigned by name onto this corpus's
+        numbering (``load_snapshot_state(idx=...)``), so the snapshot may
+        come from an earlier (smaller) corpus or the other load plane.
+        Precondition: the snapshot's corpus is a *subset* of this one —
+        saturation is monotone, so consequences of since-retracted
+        axioms would survive into the result."""
         timer = PhaseTimer(enabled=self.config.instrumentation)
         cfg = self.config
         norm = None
@@ -148,9 +162,22 @@ class ELClassifier:
                 normalizer.save_cache(cfg.normalize_cache_path)
             with timer.phase("index"):
                 idx = Indexer().index(norm)
+        engine = self._make_engine(idx)
+        initial = None
+        if resume_from is not None:
+            with timer.phase("resume(align)"):
+                from distel_tpu.runtime.checkpoint import load_snapshot_state
+
+                # the wire-packed (v2) form re-embeds without densifying,
+                # but only engines that declare accepts_wire_state take
+                # it; others get the x-major bool view
+                initial, _info = load_snapshot_state(
+                    resume_from,
+                    idx=idx,
+                    unpack=not getattr(engine, "accepts_wire_state", False),
+                )
         with timer.phase("compile+saturate"):
-            engine = self._make_engine(idx)
-            result = engine.saturate(cfg.max_iterations)
+            result = engine.saturate(cfg.max_iterations, initial=initial)
         with timer.phase("taxonomy"):
             taxonomy = extract_taxonomy(result)
         if verify:

@@ -480,3 +480,33 @@ def test_snapshot_resume_across_renumbered_index():
         state_u, _ = load_snapshot_state(p, unpack=True, idx=idx_b)
         resumed_u = eng_b.saturate(initial=state_u)
         assert resumed_u.derivations == resumed.derivations
+
+
+def test_classify_resume_from_snapshot(tmp_path):
+    """CLI-level RDB-reload parity: classify, snapshot, extend the
+    corpus, classify again warm-started from the snapshot — same
+    taxonomy as a cold run of the grown corpus."""
+    base = (
+        "SubClassOf(Cat Mammal)\nSubClassOf(Mammal Animal)\n"
+        "SubClassOf(Cat ObjectSomeValuesFrom(partOf Zoo))\n"
+        "SubClassOf(ObjectSomeValuesFrom(partOf Zoo) Captive)\n"
+    )
+    grown = "SubClassOf(Aardvark Mammal)\n" + base
+    from distel_tpu.runtime.checkpoint import save_snapshot
+
+    cfg = ClassifierConfig(use_native_loader=False)
+    clf = ELClassifier(cfg)
+    first = clf.classify_text(base)
+    snap = str(tmp_path / "s.npz")
+    save_snapshot(snap, first.result)
+    # renumbering really happened (else this degrades to a cold-run test)
+    assert (
+        first.idx.concept_names
+        != ELClassifier(cfg).classify_text(grown).idx.concept_names[
+            : len(first.idx.concept_names)
+        ]
+    )
+    warm = clf.classify_text(grown, resume_from=snap)
+    cold = clf.classify_text(grown)
+    assert warm.taxonomy.parents == cold.taxonomy.parents
+    assert warm.taxonomy.equivalents == cold.taxonomy.equivalents
