@@ -1,0 +1,73 @@
+"""Pin the current process to a virtual multi-device CPU mesh.
+
+Single source of truth for the forced-CPU-mesh recipe used by BOTH
+``tests/conftest.py`` (pytest: every test runs on an 8-device virtual mesh,
+mirroring the reference's single-host-multi-shard mode, reference
+``README.md:43``) and ``__graft_entry__._dryrun_child`` (the driver's
+multichip gate subprocess).
+
+Why this dance is needed: the environment pre-registers the axon TPU-tunnel
+plugin at interpreter start (sitecustomize, keyed on ``PALLAS_AXON_POOL_IPS``)
+and pins ``jax_platforms="axon,cpu"`` via ``jax.config`` — which an env var
+cannot override after the fact.  Sharded tests and the multichip dryrun must
+never depend on (or hold) the single real chip, so we force the config back to
+cpu, drop the non-cpu backend factories before any backend initializes, and
+clear the pool var so subprocesses never re-register the tunnel either.
+
+All gate-critical checks raise ``RuntimeError`` (never bare ``assert``) so the
+validation survives ``PYTHONOPTIMIZE``.
+"""
+
+import os
+
+
+def force_cpu_mesh(n_devices: int, exact: bool = False) -> None:
+    """Force a >= ``n_devices``-device virtual CPU mesh in this process.
+
+    Must run before any JAX backend initializes (importing jax is fine;
+    creating arrays / calling ``jax.devices()`` is not).  With
+    ``exact=True`` require exactly ``n_devices`` devices.
+    """
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""  # subprocesses: no tunnel
+
+    import jax
+
+    # Import pallas while any tpu platform is still registered — its lowering
+    # registration needs the platform name, and callers exercise the Pallas
+    # interpreter path on CPU.
+    import jax.experimental.pallas  # noqa: F401
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as xb
+
+        if xb.backends_are_initialized():
+            raise RuntimeError(
+                "JAX backends initialized before force_cpu_mesh could pin cpu"
+            )
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+    except (ImportError, AttributeError):
+        # private-API drift tolerated: jax.config.update above suffices alone
+        pass
+
+    devices = jax.devices()
+    ok_count = (
+        len(devices) == n_devices if exact else len(devices) >= n_devices
+    )
+    if not ok_count:
+        raise RuntimeError(
+            f"expected {'exactly' if exact else 'at least'} {n_devices} "
+            f"virtual CPU devices, got {devices}"
+        )
+    if any(d.platform != "cpu" for d in devices):
+        raise RuntimeError(f"non-cpu device in forced mesh: {devices}")

@@ -4,47 +4,11 @@ Mirrors the reference's single-host-multi-shard test mode ("minimum of 7
 Redis instances ... on the single machine", reference README.md:43): real
 GSPMD partitioning, virtual devices.
 
-The environment pre-registers the axon TPU-tunnel plugin at interpreter
-start (sitecustomize, keyed on PALLAS_AXON_POOL_IPS) and pins
-``jax_platforms="axon,cpu"`` via ``jax.config`` — which an env var cannot
-override after the fact.  Tests must never depend on (or hold) the single
-real chip, so we force the config back to cpu, drop the non-cpu backend
-factories before any backend initializes, and clear the pool var so test
-subprocesses never re-register the tunnel either.
+The recipe itself (env pinning, backend-factory drop, pallas import order)
+lives in distel_tpu.testing.cpumesh so the driver's multichip-gate
+subprocess (__graft_entry__._dryrun_child) uses the identical code path.
 """
 
-import os
+from distel_tpu.testing.cpumesh import force_cpu_mesh
 
-_N_DEVICES = 8
-_flags = [
-    f
-    for f in os.environ.get("XLA_FLAGS", "").split()
-    if "xla_force_host_platform_device_count" not in f
-]
-_flags.append(f"--xla_force_host_platform_device_count={_N_DEVICES}")
-os.environ["XLA_FLAGS"] = " ".join(_flags)
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""  # subprocesses: no tunnel registration
-
-import jax  # noqa: E402
-
-# Import pallas while the tpu platform is still registered — its lowering
-# registration needs the platform name, and tests exercise the Pallas
-# interpreter on CPU.
-import jax.experimental.pallas  # noqa: E402,F401
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    import jax._src.xla_bridge as _xb
-
-    assert not _xb.backends_are_initialized(), (
-        "JAX backends initialized before conftest could pin cpu"
-    )
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-except (ImportError, AttributeError):
-    # private-API drift tolerated: jax.config.update above suffices alone
-    pass
-
-assert len(jax.devices()) == _N_DEVICES, jax.devices()
+force_cpu_mesh(8, exact=True)
