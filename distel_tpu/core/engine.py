@@ -164,6 +164,28 @@ def _host_bit_total(bits: np.ndarray) -> int:
     return int(np.asarray(bits, np.int64).sum())
 
 
+def check_embed_fits(allow_shrink: bool, **dims: Tuple[int, int]) -> None:
+    """Refuse to silently clip a shrinking universe on resume.
+
+    ``dims`` maps an axis name to ``(old, new)``; any ``old > new`` means
+    the caller is embedding a state whose universe exceeds this engine's —
+    concept ids are append-only, so that only happens on a mismatched
+    snapshot (wrong corpus / unaligned names), and clipping would warm-start
+    from a silently truncated closure.  Name-realign instead
+    (``load_snapshot_state(..., idx=idx)``) or opt in explicitly."""
+    if allow_shrink:
+        return
+    over = {k: v for k, v in dims.items() if v[0] > v[1]}
+    if over:
+        detail = ", ".join(f"{k}: {o} > {n}" for k, (o, n) in over.items())
+        raise ValueError(
+            f"embed_state: old state exceeds this engine's universe "
+            f"({detail}); realign the snapshot by name "
+            f"(load_snapshot_state(path, idx=engine.idx)) or pass "
+            f"allow_shrink=True to clip deliberately"
+        )
+
+
 def observed_loop(
     observe_step, s, r, init_total: int, unroll: int, budget: int, observer
 ):
@@ -353,7 +375,9 @@ class SaturationEngine:
             self._initial_jit = jax.jit(self._initial_arrays)
         return self._initial_jit()
 
-    def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:
+    def embed_state(
+        self, s_old, r_old, *, allow_shrink: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
         """Embed a previous saturated state (old concept/link universe) into
         this engine's (padded, possibly larger) arrays.  Ids are stable by
         construction (``Indexer`` interns append-only), so the old arrays
@@ -367,6 +391,13 @@ class SaturationEngine:
                 "load_snapshot_state(path, unpack=True))"
             )
         no, lo = s_old.shape[0], r_old.shape[1]
+        check_embed_fits(
+            allow_shrink,
+            concepts=(no, self.nc),
+            subsumers=(s_old.shape[1], self.nc),
+            link_rows=(r_old.shape[0], self.nc),
+            links=(lo, self.nl),
+        )
         if (no, s_old.shape[1], lo) == (self.nc, self.nc, self.nl):
             s, r = jnp.asarray(s_old), jnp.asarray(r_old)
         else:

@@ -92,12 +92,12 @@ def apply_rules_host(
 
 
 class HybridSaturator:
-    #: delegates embedding to the row-packed engine
-    accepts_wire_state = True
-
     """Saturates with the TPU engine applying ``tpu_rules`` and the host
     applying ``host_rules``, alternating to a global fixed point.  API
     matches the engines' ``saturate``."""
+
+    #: delegates embedding to the row-packed engine
+    accepts_wire_state = True
 
     def __init__(
         self,

@@ -48,6 +48,7 @@ from jax import lax
 
 from distel_tpu.core.engine import (
     SaturationResult,
+    check_embed_fits,
     _pad_up,
     finish_device_run,
 )
@@ -388,7 +389,9 @@ class PackedSaturationEngine:
             out, self.idx, budget, allow_incomplete, transposed=False
         )
 
-    def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:
+    def embed_state(
+        self, s_old, r_old, *, allow_shrink: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
         """Embed an *unpacked* bool state (e.g. from a snapshot) into this
         engine's packed arrays — the incremental/resume path."""
         if np.asarray(s_old).dtype == np.uint32:
@@ -399,6 +402,13 @@ class PackedSaturationEngine:
             )
         s_old = np.asarray(s_old, bool)
         r_old = np.asarray(r_old, bool)
+        check_embed_fits(
+            allow_shrink,
+            concepts=(s_old.shape[0], self.nc),
+            subsumers=(s_old.shape[1], self.nc),
+            link_rows=(r_old.shape[0], self.nc),
+            links=(r_old.shape[1], self.nl),
+        )
         s = np.zeros((self.nc, self.nc), bool)
         np.fill_diagonal(s, True)
         s[:, TOP_ID] = True

@@ -67,6 +67,7 @@ from jax import lax
 
 from distel_tpu.core.engine import (
     SaturationResult,
+    check_embed_fits,
     _host_bit_total,
     _pad_up,
     fetch_global,
@@ -359,7 +360,9 @@ class RowPackedSaturationEngine:
             )
         return self._initial_jit()
 
-    def embed_state(self, s_old, r_old) -> Tuple[jax.Array, jax.Array]:
+    def embed_state(
+        self, s_old, r_old, *, allow_shrink: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
         """Embed a previous closure into this engine's (possibly larger)
         transposed packed arrays — the incremental/resume path.
 
@@ -373,9 +376,20 @@ class RowPackedSaturationEngine:
         with S(x)={x,⊤} and no axioms — i.e. the correct warm start for
         ids later assigned to new concepts."""
         if np.asarray(s_old).dtype == np.uint32:
-            return self._embed_packed(np.asarray(s_old), np.asarray(r_old))
+            return self._embed_packed(
+                np.asarray(s_old),
+                np.asarray(r_old),
+                allow_shrink=allow_shrink,
+            )
         s_old = np.asarray(s_old, bool)
         r_old = np.asarray(r_old, bool)
+        check_embed_fits(
+            allow_shrink,
+            concepts=(s_old.shape[0], self.nc),
+            subsumers=(s_old.shape[1], self.nc),
+            link_rows=(r_old.shape[0], self.nc),
+            links=(r_old.shape[1], self.nl),
+        )
 
         def pack_rows(m: np.ndarray) -> np.ndarray:
             pad = (-m.shape[1]) % 32
@@ -406,10 +420,20 @@ class RowPackedSaturationEngine:
         return jnp.asarray(sp), jnp.asarray(rp)
 
     def _embed_packed(
-        self, sp_old: np.ndarray, rp_old: np.ndarray
+        self,
+        sp_old: np.ndarray,
+        rp_old: np.ndarray,
+        *,
+        allow_shrink: bool = False,
     ) -> Tuple[jax.Array, jax.Array]:
         """Copy packed transposed state into the (grown) arrays: stable
         ids mean old words land verbatim in the low words of each row."""
+        check_embed_fits(
+            allow_shrink,
+            subsumer_rows=(sp_old.shape[0], self.nc),
+            x_words=(sp_old.shape[1], self.wc),
+            link_rows=(rp_old.shape[0], self.nl),
+        )
         rows = np.arange(self.nc)
         sp = np.zeros((self.nc, self.wc), np.uint32)
         sp[rows, rows >> 5] = np.uint32(1) << (rows & 31).astype(np.uint32)

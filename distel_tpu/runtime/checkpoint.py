@@ -125,11 +125,14 @@ def align_snapshot_state(
     new_link_ids = {
         (int(r), int(f)): i for i, (r, f) in enumerate(idx.links)
     }
+    rmap_raw = np.asarray(
+        [idx.role_ids.get(nm, -1) for nm in old_rnames], np.int64
+    )
     if (cmap_raw == np.arange(len(old_cnames))).all():
         # exact same numbering (the persistent-Indexer contract) — the
         # common fast path, and the only case where generated names are
         # trustworthy
-        lmap_id = _link_map(old_links, old_rnames, cmap_raw, new_link_ids, idx)
+        lmap_id = _link_map(old_links, rmap_raw, cmap_raw, new_link_ids)
         if (lmap_id == np.arange(len(old_links))).all():
             return state
     # Generated names (gensym/aux) are PLANE- and HISTORY-dependent: the
@@ -137,11 +140,20 @@ def align_snapshot_state(
     # the Python and native normalizers, so matching them by name would
     # inject wrong rows.  Drop them — a warm start may be any sound
     # subset of a closure; the resumed saturation re-derives the rest.
+    # Generated ROLES (chain intermediates, "distel:genrole#N" — counter
+    # shared with concept gensyms) are equally history-dependent: the
+    # same name can denote a different chain intermediate across load
+    # planes or corpus growth, and a name-matched R row under the wrong
+    # role would survive monotone saturation into an unsound closure.
     cmap = cmap_raw.copy()
     for i, nm in enumerate(old_cnames):
         if nm.startswith(("distel:gensym#", "distel:aux#")):
             cmap[i] = -1
-    lmap = _link_map(old_links, old_rnames, cmap, new_link_ids, idx)
+    rmap = rmap_raw.copy()
+    for i, nm in enumerate(old_rnames):
+        if nm.startswith("distel:genrole#"):
+            rmap[i] = -1
+    lmap = _link_map(old_links, rmap, cmap, new_link_ids)
     n_old = len(old_cnames)
     s, r = np.asarray(state[0]), np.asarray(state[1])
     if s.dtype == np.uint32:
@@ -162,18 +174,17 @@ def align_snapshot_state(
 
 def _link_map(
     old_links: np.ndarray,
-    old_rnames: list,
+    rmap: np.ndarray,
     cmap: np.ndarray,
     new_link_ids: dict,
-    idx: IndexedOntology,
 ) -> np.ndarray:
-    """old link id → new link id via (role name, mapped filler)."""
+    """old link id → new link id via (mapped role, mapped filler)."""
     lmap = np.full(len(old_links), -1, np.int64)
     for i, (r, f) in enumerate(old_links):
-        nr = idx.role_ids.get(old_rnames[r], -1)
+        nr = rmap[r]
         nf = cmap[f]
         if nr >= 0 and nf >= 0:
-            lmap[i] = new_link_ids.get((nr, int(nf)), -1)
+            lmap[i] = new_link_ids.get((int(nr), int(nf)), -1)
     return lmap
 
 

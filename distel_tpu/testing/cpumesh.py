@@ -21,6 +21,24 @@ validation survives ``PYTHONOPTIMIZE``.
 import os
 
 
+def cpu_mesh_env(n_devices: int, base: dict = None) -> dict:
+    """The env-var half of the recipe, as a dict suitable for both
+    ``os.environ.update`` (in-process, before backend init) and
+    ``subprocess`` env= (where clearing ``PALLAS_AXON_POOL_IPS`` must
+    happen before the child's interpreter even starts)."""
+    env = dict(os.environ if base is None else base)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # subprocesses: no tunnel
+    return env
+
+
 def force_cpu_mesh(n_devices: int, exact: bool = False) -> None:
     """Force a >= ``n_devices``-device virtual CPU mesh in this process.
 
@@ -28,15 +46,7 @@ def force_cpu_mesh(n_devices: int, exact: bool = False) -> None:
     creating arrays / calling ``jax.devices()`` is not).  With
     ``exact=True`` require exactly ``n_devices`` devices.
     """
-    flags = [
-        f
-        for f in os.environ.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
-    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
-    os.environ["XLA_FLAGS"] = " ".join(flags)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""  # subprocesses: no tunnel
+    os.environ.update(cpu_mesh_env(n_devices))
 
     import jax
 

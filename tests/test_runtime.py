@@ -510,3 +510,114 @@ def test_classify_resume_from_snapshot(tmp_path):
     cold = clf.classify_text(grown)
     assert warm.taxonomy.parents == cold.taxonomy.parents
     assert warm.taxonomy.equivalents == cold.taxonomy.equivalents
+
+
+def test_snapshot_resume_drops_generated_chain_roles():
+    """Generated chain-intermediate roles (distel:genrole#N, counter
+    shared with concept gensyms) are history-dependent names: across a
+    corpus change the same name can denote a DIFFERENT intermediate.
+    Name-matched realignment of their R rows would inject pairs under
+    the wrong role, and monotone saturation would keep them — an
+    unsound closure.  Alignment must drop them and let the resumed
+    saturation re-derive."""
+    import tempfile
+
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+    from distel_tpu.runtime.checkpoint import load_snapshot_state
+    from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+    def _indexed(text):
+        norm = normalize(parser.parse(text))
+        return norm, index_ontology(norm)
+
+    # base: a length-3 chain p∘q∘t ⊑ u — the normalizer mints
+    # distel:genrole#K for the p∘q intermediate; the closure holds
+    # (X, Z) under that generated role.
+    base = (
+        "SubObjectPropertyOf(ObjectPropertyChain(p q t) u)\n"
+        "SubClassOf(X ObjectSomeValuesFrom(p Y))\n"
+        "SubClassOf(Y ObjectSomeValuesFrom(q Z))\n"
+        "SubClassOf(Z ObjectSomeValuesFrom(t W))\n"
+        "SubClassOf(ObjectSomeValuesFrom(u W) Goal)\n"
+    )
+    # grown: a DIFFERENT length-3 chain a∘b∘t ⊑ d normalizes first, so
+    # ITS intermediate now takes the same distel:genrole#K name — with a
+    # b-filler named Z so the old (genrole#K, Z) link name-matches.  A
+    # name-based realign would hand (X, Z) to the a∘b intermediate, CR6
+    # would fire genrole#K∘t⊑d on Z's t-link, and Bad would wrongly
+    # enter S(X).
+    grown = (
+        "SubObjectPropertyOf(ObjectPropertyChain(a b t) d)\n"
+        "SubClassOf(M ObjectSomeValuesFrom(a N))\n"
+        "SubClassOf(N ObjectSomeValuesFrom(b Z))\n"
+        "SubClassOf(ObjectSomeValuesFrom(d W) Bad)\n"
+    ) + base
+    norm_a, idx_a = _indexed(base)
+    assert any(
+        nm.startswith("distel:genrole#") for nm in idx_a.role_names
+    ), "test premise: the chain split must mint a generated role"
+    res_a = RowPackedSaturationEngine(idx_a).saturate()
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "snap.npz")
+        save_snapshot(p, res_a)
+        norm_b, idx_b = _indexed(grown)
+        # test premise: the same generated role NAME exists in both
+        # indices but denotes different chain intermediates
+        shared = set(n for n in idx_a.role_names if "genrole" in n) & set(
+            n for n in idx_b.role_names if "genrole" in n
+        )
+        assert shared, "test premise: generated role names must collide"
+        eng_b = RowPackedSaturationEngine(idx_b)
+        for unpack in (False, True):
+            state, _ = load_snapshot_state(p, unpack=unpack, idx=idx_b)
+            resumed = eng_b.saturate(initial=state)
+            report = diff_engine_vs_oracle(norm_b, resumed)
+            assert report.ok(), report.summary()
+            bad = idx_b.concept_ids["Bad"]
+            x = idx_b.concept_ids["X"]
+            assert bad not in resumed.subsumers(x)
+
+
+def test_embed_state_rejects_shrinking_universe():
+    """A snapshot larger than the resuming engine's universe means a
+    mismatched (unaligned) resume; clipping it silently would warm-start
+    from a truncated closure.  embed_state must raise unless the caller
+    opts in."""
+    from distel_tpu.core.engine import SaturationEngine
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.packed_engine import PackedSaturationEngine
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+
+    def _indexed(text):
+        return index_ontology(normalize(parser.parse(text)))
+
+    small = _indexed("SubClassOf(A B)\n")
+    # engines pad state to 128-concept multiples, and embed receives the
+    # padded arrays — the clip (and hence the guard) only engages when the
+    # old universe crosses the new engine's padded capacity
+    big = _indexed(
+        "".join(f"SubClassOf(C{i} C{i + 1})\n" for i in range(140))
+        + "SubClassOf(C0 ObjectSomeValuesFrom(r D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r D) E)\n"
+    )
+    big_res = RowPackedSaturationEngine(big).saturate()
+    for eng_cls in (SaturationEngine, PackedSaturationEngine):
+        eng = eng_cls(small)
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.embed_state(big_res.s, big_res.r)
+        eng.embed_state(big_res.s, big_res.r, allow_shrink=True)
+    rp = RowPackedSaturationEngine(small)
+    with pytest.raises(ValueError, match="exceeds"):
+        rp.embed_state(big_res.s, big_res.r)  # unpacked route
+    with pytest.raises(ValueError, match="exceeds"):
+        rp.embed_state(big_res.packed_s, big_res.packed_r)  # packed route
+    rp.embed_state(big_res.s, big_res.r, allow_shrink=True)
+    rp.embed_state(big_res.packed_s, big_res.packed_r, allow_shrink=True)
+    # the saturate(initial=...) path inherits the strict default
+    with pytest.raises(ValueError, match="exceeds"):
+        rp.saturate(initial=(big_res.packed_s, big_res.packed_r))
