@@ -39,6 +39,37 @@ def cpu_mesh_env(n_devices: int, base: dict = None) -> dict:
     return env
 
 
+def cpu_mesh_ready(n_devices: int) -> bool:
+    """True iff JAX in THIS process is already initialized on a pure-CPU
+    backend with at least ``n_devices`` devices (the pytest/conftest case).
+
+    Deliberately does NOT call ``jax.devices()`` when backends are still
+    uninitialized: in the driver environment a sitecustomize hook
+    pre-registers the axon TPU-tunnel plugin, so touching the backend here
+    would initialize the one real chip — exactly the failure recorded in
+    MULTICHIP_r01.json (libtpu client/terminal mismatch inside the first
+    compile)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    jax = sys.modules["jax"]
+    try:
+        import jax._src.xla_bridge as xb
+
+        if not xb.backends_are_initialized():
+            return False
+    except (ImportError, AttributeError):
+        return False  # private-API drift: report not-ready (safe path)
+    try:
+        devices = jax.devices()
+    except Exception:
+        return False
+    return len(devices) >= n_devices and all(
+        d.platform == "cpu" for d in devices
+    )
+
+
 def force_cpu_mesh(n_devices: int, exact: bool = False) -> None:
     """Force a >= ``n_devices``-device virtual CPU mesh in this process.
 

@@ -1,0 +1,170 @@
+"""Golden-closure fixtures: ground truth INDEPENDENT of the in-repo oracle.
+
+The reference's entire test strategy is differential against an external
+reasoner (reference ``test/ELClassifierTest.java:363-446``; README.md:40
+"verified against ... ELK, jCEL or Pellet").  No external reasoner is
+installable in this environment, so the external-truth role is played by
+``tests/golden/``: hand-computed ontologies whose complete closures were
+derived axiom-by-axiom on paper (each ``.expected`` file documents the
+reasoning).  A misconception shared by ``core/oracle.py`` and the engines
+fails here, which the oracle-differential harness alone cannot catch.
+
+Checker contract (see ``_load_expected``):
+
+* For every named atom X (concepts, ``ind:`` individuals, datatype
+  classes — everything except generated ``distel:*`` names), the set of
+  entailed non-trivial subsumers {Y : X <= Y, Y not in {X, owl:Thing}}
+  must EXACTLY equal the fixture's lines — extras are unsoundness,
+  misses are incompleteness.
+* If the fixture lists ``X <= owl:Nothing``, X is unsatisfiable: the
+  checker requires bottom plus at-least the listed subsumers (an
+  unsatisfiable class entails everything, so exactness is meaningless).
+"""
+
+from pathlib import Path
+
+import pytest
+
+from distel_tpu.core import oracle as oracle_mod
+from distel_tpu.core.engine import SaturationEngine
+from distel_tpu.core.hybrid import HybridSaturator
+from distel_tpu.core.indexing import atom_key, index_ontology
+from distel_tpu.core.packed_engine import PackedSaturationEngine
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.owl import parser
+
+GOLDEN_DIR = Path(__file__).parent / "golden"
+FIXTURES = sorted(GOLDEN_DIR.glob("*.ofn"))
+
+BOTTOM = "owl:Nothing"
+TOP = "owl:Thing"
+
+
+def _load_expected(path: Path) -> dict:
+    """Parse ``X <= Y`` lines into {X: {Y, ...}}."""
+    expected = {}
+    for ln, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split("<=")
+        assert len(parts) == 2, f"{path.name}:{ln}: malformed line {raw!r}"
+        x, y = parts[0].strip(), parts[1].strip()
+        expected.setdefault(x, set()).add(y)
+    return expected
+
+
+def _named_closure(result) -> dict:
+    """{named atom: set of named non-trivial subsumers} from an engine
+    result (or an oracle result, duck-typed via subsumer_dict)."""
+    idx = result.idx
+    out = {}
+    for name, cid in idx.concept_ids.items():
+        if name.startswith("distel:") or name in (TOP, BOTTOM):
+            continue
+        sups = {
+            idx.concept_names[i]
+            for i in result.subsumers(cid)
+            if i < idx.n_concepts
+        }
+        out[name] = {
+            s
+            for s in sups
+            if not s.startswith("distel:") and s not in (name, TOP)
+        }
+    return out
+
+
+class _OracleRunner:
+    """Presents core.oracle as an engine-shaped runner."""
+
+    name = "oracle"
+
+    def run(self, norm, idx):
+        res = oracle_mod.saturate(norm)
+        out = {}
+        for atom, sups in res.subsumers.items():
+            out[atom_key(atom)] = {atom_key(s) for s in sups}
+        closure = {}
+        for name in idx.concept_ids:
+            if name.startswith("distel:") or name in (TOP, BOTTOM):
+                continue
+            sups = out.get(name, set())
+            closure[name] = {
+                s
+                for s in sups
+                if not s.startswith("distel:") and s not in (name, TOP)
+            }
+        return closure
+
+
+class _EngineRunner:
+    def __init__(self, cls, name, **kw):
+        self.cls, self.name, self.kw = cls, name, kw
+
+    def run(self, norm, idx):
+        return _named_closure(self.cls(idx, **self.kw).saturate())
+
+
+class _HybridRunner:
+    """Exercises the per-rule backend plugin boundary on the goldens."""
+
+    name = "hybrid"
+
+    def run(self, norm, idx):
+        return _named_closure(
+            HybridSaturator(idx, {"CR4": "host", "CR6": "host"}).saturate()
+        )
+
+
+RUNNERS = [
+    _OracleRunner(),
+    _EngineRunner(SaturationEngine, "dense"),
+    _EngineRunner(PackedSaturationEngine, "packed"),
+    _EngineRunner(RowPackedSaturationEngine, "rowpacked"),
+    _HybridRunner(),
+]
+
+
+@pytest.mark.parametrize("runner", RUNNERS, ids=lambda r: r.name)
+@pytest.mark.parametrize("path", FIXTURES, ids=lambda p: p.stem)
+def test_golden_closure(path, runner):
+    expected = _load_expected(path.with_suffix(".expected"))
+    norm = normalize(parser.parse(path.read_text()))
+    idx = index_ontology(norm)
+    closure = runner.run(norm, idx)
+
+    # every concept the fixture names must exist
+    missing_atoms = set(expected) - set(closure)
+    assert not missing_atoms, (
+        f"{path.stem}: expected concepts absent from the index: "
+        f"{sorted(missing_atoms)}"
+    )
+
+    errors = []
+    for x, sups in sorted(closure.items()):
+        want = expected.get(x, set())
+        if BOTTOM in want:
+            # unsatisfiable: bottom required, listed subsumers required,
+            # extras permitted (entails everything)
+            if BOTTOM not in sups:
+                errors.append(f"{x}: expected unsatisfiable, bottom missing")
+            lost = (want - {BOTTOM}) - sups
+            if lost:
+                errors.append(f"{x}: missing {sorted(lost)}")
+            continue
+        if sups != want:
+            extra, lost = sups - want, want - sups
+            if extra:
+                errors.append(f"{x}: unsound extra {sorted(extra)}")
+            if lost:
+                errors.append(f"{x}: missing {sorted(lost)}")
+    assert not errors, f"{path.stem} [{runner.name}]:\n  " + "\n  ".join(errors)
+
+
+def test_golden_fixture_inventory():
+    """The fixture set must stay non-trivial and paired."""
+    assert len(FIXTURES) >= 20
+    for p in FIXTURES:
+        assert p.with_suffix(".expected").exists(), f"{p.stem} unpaired"
