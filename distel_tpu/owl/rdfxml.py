@@ -151,6 +151,9 @@ class _AxiomBuilder:
             or f"{OWL}TransitiveProperty" in t
             or f"{OWL}ReflexiveProperty" in t
         }
+        self.data_properties = {
+            s for s, t in types.items() if f"{OWL}DatatypeProperty" in t
+        }
         self.individuals = {
             s for s, t in types.items() if f"{OWL}NamedIndividual" in t
         }
@@ -261,11 +264,38 @@ class _AxiomBuilder:
                     onto.add(
                         S.ObjectPropertyRange(S.ObjectProperty(s), self.expr(o))
                     )
+            elif p == f"{OWL}inverseOf" and not s.startswith("_:"):
+                # out-of-profile property axiom: drop-and-record, like the
+                # reference's Normalizer.getRemovedTypes
+                # (init/Normalizer.java:863).  Blank-node subjects are
+                # anonymous inverse EXPRESSIONS (ObjectInverseOf inside
+                # owl:onProperty), not axioms — those keep flowing through
+                # expr() and are reported by the profile checker instead.
+                onto.add(S.UnsupportedAxiom("InverseObjectProperties", (s, o)))
+            elif p == f"{OWL}propertyDisjointWith" and not s.startswith("_:"):
+                onto.add(S.UnsupportedAxiom("DisjointObjectProperties", (s, o)))
             elif p == _TYPE:
                 if o == f"{OWL}TransitiveProperty" and not s.startswith("_:"):
                     onto.add(S.TransitiveObjectProperty(S.ObjectProperty(s)))
                 elif o == f"{OWL}ReflexiveProperty":
                     onto.add(S.ReflexiveObjectProperty(S.ObjectProperty(s)))
+                elif o in (
+                    f"{OWL}FunctionalProperty",
+                    f"{OWL}InverseFunctionalProperty",
+                    f"{OWL}SymmetricProperty",
+                    f"{OWL}AsymmetricProperty",
+                    f"{OWL}IrreflexiveProperty",
+                ) and not s.startswith("_:"):
+                    # record under the OWL *axiom* name (the spelling the
+                    # functional-syntax and OWL/XML readers use) so removed
+                    # reports compare across serializations of one corpus
+                    kind = o[len(OWL):].replace("Property", "")
+                    suffix = (
+                        "DataProperty"
+                        if s in self.data_properties
+                        else "ObjectProperty"
+                    )
+                    onto.add(S.UnsupportedAxiom(kind + suffix, (s,)))
                 elif (
                     not o.startswith(OWL)
                     and not o.startswith(RDF)
