@@ -106,7 +106,7 @@ class RowPackedSaturationEngine:
         unroll: int = 2,
         mesh: Optional[jax.sharding.Mesh] = None,
         word_axis: str = "c",
-        temp_budget_bytes: int = 1 << 29,
+        temp_budget_bytes: Optional[int] = None,
         use_pallas: Optional[bool] = None,
         rules: Optional[frozenset] = None,
         mm_opts: Optional[dict] = None,
@@ -119,9 +119,14 @@ class RowPackedSaturationEngine:
         ``mm_opts``: extra keyword overrides for the CR4/CR6
         :class:`PackedColsMatmulPlan` (tiling, ``skip_zero_tiles``,
         ``interpret``) — the test hook for pinning a kernel variant.
-        ``gate_chunks``: frontier-gated chunk skipping (None = auto,
-        enabled at ≥32k concepts where skipped work outweighs the
-        per-chunk branch)."""
+        ``gate_chunks``: frontier-gated chunk skipping (None = auto:
+        enabled from 32k concepts, where skipped work outweighs the
+        per-chunk branch, up to the large-state threshold — past ~2.5 GB
+        of per-shard packed state the auto posture disables gating and
+        halves ``temp_budget_bytes``, trading the skip speedup for the
+        ~3 GB of cond pass-through copies that otherwise OOM one chip;
+        see the measured figures at the threshold computation in
+        ``__init__``)."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
@@ -139,6 +144,24 @@ class RowPackedSaturationEngine:
         )
         self.nl = max(_pad_up(idx.n_links, 32), 32)
         self.wc = self.nc // 32
+        # ---- size-adaptive memory posture (measured on a 16 GB v5e with
+        # the 96k-class many-role corpus, state = S_T 2.2 GB + R_T 1.6 GB):
+        # * 512 MB chunk temporaries + per-chunk frontier gating peak at
+        #   14.2 GB of XLA temp and OOM;
+        # * the lax.cond gate itself costs ~3.1 GB (state-valued branches
+        #   force pass-through copies, and the tunnel XLA does not reuse
+        #   cond-branch buffers across sequential chunks);
+        # * ungated with 256 MB chunks the same program peaks at 12.6 GB
+        #   total-live and runs.
+        # So past ~2.5 GB of packed state (the 64k-class regime that was
+        # round 1's single-chip ceiling) the engine drops to the tight
+        # budget and disables gating unless the caller pinned either.
+        state_bytes = (self.nc + self.nl) * self.wc * 4 // max(self.n_shards, 1)
+        large = state_bytes > (5 << 29)
+        if temp_budget_bytes is None:
+            temp_budget_bytes = (1 << 28) if large else (1 << 29)
+        if gate_chunks is None and large:
+            gate_chunks = False
         # int8 × int8 → int32 runs 2x bf16 on the MXU and is exact
         self.matmul_dtype = jnp.int8 if matmul_dtype is None else matmul_dtype
 
@@ -638,58 +661,59 @@ class RowPackedSaturationEngine:
         ch = jnp.asarray(False)
         s_vecs, r_vecs = [], []
         flag = iter(range(self._gate["n_flags"])) if gating else None
+        width = sp.shape[1]  # shard-local word width
 
-        def gated(n_targets, operand, do, keep):
-            """Run ``do(operand) → (written-state, rowwise-change)``
-            under this chunk's dirty flag; a skipped chunk forwards
-            ``keep(operand)`` (the written state, untouched) with a zero
-            change vector.  The one cond-skip protocol every rule chunk
-            shares — the flag iterator consumes indices in
+        def gated_rows(n_targets, operand, compute):
+            """``compute(operand) → reduced`` [n_targets, width] under
+            this chunk's dirty flag (zeros when clean).  Only the
+            chunk-bounded reduced rows cross the cond boundary; the
+            caller ORs them in unconditionally (OR with zeros is the
+            identity), so the state stays a linear scatter chain the
+            compiler aliases in place.  Wrapping the whole rule in the
+            cond instead forces a pass-through copy of the multi-GB
+            state per skipped branch — measured +3.1 GB peak at 96k
+            many-role classes, the difference between fitting one chip
+            and OOM.  The flag iterator consumes indices in
             ``_build_gate``'s reader order."""
             if not gating:
-                return do(operand)
+                return compute(operand)
             return lax.cond(
                 dirty[next(flag)],
-                do,
-                lambda ops: (keep(ops), jnp.zeros(n_targets, bool)),
+                compute,
+                lambda _ops: jnp.zeros((n_targets, width), jnp.uint32),
                 operand,
             )
 
         # CR1: a ⊑ b
         for sl, plan in self._cr1_chunks:
-            sp, cv = gated(
+            red = gated_rows(
                 plan.n_targets,
                 sp,
-                lambda s, sl=sl, plan=plan: plan.apply(
-                    s, s[self._src1[sl]], track="rows"
-                ),
-                lambda s: s,
+                lambda s, sl=sl, plan=plan: plan.reduce(s[self._src1[sl]]),
             )
+            sp, cv = plan.write(sp, red, track="rows")
             s_vecs.append(cv)
             ch |= jnp.any(cv)
         # CR2: a1 ⊓ a2 ⊑ b
         for sl, plan in self._cr2_chunks:
-            sp, cv = gated(
+            red = gated_rows(
                 plan.n_targets,
                 sp,
-                lambda s, sl=sl, plan=plan: plan.apply(
-                    s, s[self._src2a[sl]] & s[self._src2b[sl]], track="rows"
+                lambda s, sl=sl, plan=plan: plan.reduce(
+                    s[self._src2a[sl]] & s[self._src2b[sl]]
                 ),
-                lambda s: s,
             )
+            sp, cv = plan.write(sp, red, track="rows")
             s_vecs.append(cv)
             ch |= jnp.any(cv)
-        # CR3: a ⊑ ∃link — reads S, writes R: the cond operand carries
-        # both, the skip branch forwards R untouched
+        # CR3: a ⊑ ∃link — reads S, writes R
         for sl, plan in self._cr3_chunks:
-            rp, cv = gated(
+            red = gated_rows(
                 plan.n_targets,
-                (sp, rp),
-                lambda ops, sl=sl, plan=plan: plan.apply(
-                    ops[1], ops[0][self._src3[sl]], track="rows"
-                ),
-                lambda ops: ops[1],
+                sp,
+                lambda s, sl=sl, plan=plan: plan.reduce(s[self._src3[sl]]),
             )
+            rp, cv = plan.write(rp, red, track="rows")
             r_vecs.append(cv)
             ch |= jnp.any(cv)
         # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
@@ -745,46 +769,46 @@ class RowPackedSaturationEngine:
         if self._p4 is not None:
             for (raw, inv, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
 
-                def do4(ops, raw=raw, inv=inv, plan=plan, mm=mm):
+                def red4(ops, raw=raw, inv=inv, plan=plan, mm=mm):
                     s, r = ops
                     out = contract_from(s, r, self._a4[raw], m4[raw], mm)
-                    return plan.apply(s, out[inv], track="rows")
+                    return plan.reduce(out[inv])
 
-                sp, cv = gated(
-                    plan.n_targets, (sp, rp), do4, lambda ops: ops[0]
-                )
+                red = gated_rows(plan.n_targets, (sp, rp), red4)
+                sp, cv = plan.write(sp, red, track="rows")
                 s_vecs.append(cv)
                 ch |= jnp.any(cv)
         # CR6: role chains
         if self._p6 is not None:
             for (raw, inv, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
 
-                def do6(r, raw=raw, inv=inv, plan=plan, mm=mm):
+                def red6(r, raw=raw, inv=inv, plan=plan, mm=mm):
                     out = contract_from(r, r, self._l26[raw], m6[raw], mm)
-                    return plan.apply(r, out[inv], track="rows")
+                    return plan.reduce(out[inv])
 
-                rp, cv = gated(plan.n_targets, rp, do6, lambda r: r)
+                red = gated_rows(plan.n_targets, rp, red6)
+                rp, cv = plan.write(rp, red, track="rows")
                 r_vecs.append(cv)
                 ch |= jnp.any(cv)
         # CR5: ⊥ back-propagation — one masked packed OR-reduce
         if self._bottom:
 
-            def do5(ops):
+            def red5(ops):
                 s, r = ops
                 botf = self._bit_table(s, np.full(1, BOTTOM_ID), axis_name)
                 mask = botf[:, 0].astype(bool)              # [nl]
                 masked = jnp.where(
                     mask[:, None], r, jnp.asarray(0, jnp.uint32)
                 )
-                newrow = lax.reduce(masked, np.uint32(0), lax.bitwise_or, (0,))
-                old = s[BOTTOM_ID]
-                merged = old | newrow
-                return (
-                    s.at[BOTTOM_ID].set(merged),
-                    jnp.any(merged != old)[None],
-                )
+                return lax.reduce(
+                    masked, np.uint32(0), lax.bitwise_or, (0,)
+                )[None]
 
-            sp, cv = gated(1, (sp, rp), do5, lambda ops: ops[0])
+            red = gated_rows(1, (sp, rp), red5)
+            old5 = sp[BOTTOM_ID]
+            merged5 = old5 | red[0]
+            sp = sp.at[BOTTOM_ID].set(merged5)
+            cv = jnp.any(merged5 != old5)[None]
             s_vecs.append(cv)
             ch |= jnp.any(cv)
         if gating:

@@ -293,10 +293,24 @@ class SegmentedRowOr:
             if track == "rows":
                 return state, jnp.zeros(0, bool)
             return (state, jnp.asarray(False)) if track else state
+        return self.write(state, self.reduce(rows), track)
+
+    def write(self, state, reduced, track=False):
+        """The write half of :meth:`apply`: OR already-reduced per-target
+        rows ``reduced`` [n_targets, W] into ``state``.  Split out so a
+        gated caller can compute ``reduced`` under a ``lax.cond`` (zeros
+        when the chunk is clean — OR is the identity on zeros) while the
+        row write stays unconditional: only the chunk-bounded rows cross
+        the cond boundary, never the multi-GB state (a state-valued cond
+        branch forces a full pass-through copy per skipped chunk)."""
+        if self.k == 0:
+            if track == "rows":
+                return state, jnp.zeros(0, bool)
+            return (state, jnp.asarray(False)) if track else state
         state = jnp.asarray(state)
         t = jnp.asarray(self.targets)
         old = state[t]
-        merged = old | self.reduce(rows)
+        merged = old | reduced
         out = state.at[t].set(merged)
         if track == "rows":
             return out, jnp.any(merged != old, axis=1)

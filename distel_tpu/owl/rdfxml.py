@@ -273,7 +273,12 @@ class _AxiomBuilder:
                 # expr() and are reported by the profile checker instead.
                 onto.add(S.UnsupportedAxiom("InverseObjectProperties", (s, o)))
             elif p == f"{OWL}propertyDisjointWith" and not s.startswith("_:"):
-                onto.add(S.UnsupportedAxiom("DisjointObjectProperties", (s, o)))
+                kind = (
+                    "DisjointDataProperties"
+                    if s in self.data_properties
+                    else "DisjointObjectProperties"
+                )
+                onto.add(S.UnsupportedAxiom(kind, (s, o)))
             elif p == _TYPE:
                 if o == f"{OWL}TransitiveProperty" and not s.startswith("_:"):
                     onto.add(S.TransitiveObjectProperty(S.ObjectProperty(s)))
@@ -288,14 +293,15 @@ class _AxiomBuilder:
                 ) and not s.startswith("_:"):
                     # record under the OWL *axiom* name (the spelling the
                     # functional-syntax and OWL/XML readers use) so removed
-                    # reports compare across serializations of one corpus
+                    # reports compare across serializations of one corpus.
+                    # Of the five characteristics only Functional exists
+                    # for data properties in OWL 2
                     kind = o[len(OWL):].replace("Property", "")
-                    suffix = (
-                        "DataProperty"
-                        if s in self.data_properties
-                        else "ObjectProperty"
-                    )
-                    onto.add(S.UnsupportedAxiom(kind + suffix, (s,)))
+                    if kind == "Functional" and s in self.data_properties:
+                        kind += "DataProperty"
+                    else:
+                        kind += "ObjectProperty"
+                    onto.add(S.UnsupportedAxiom(kind, (s,)))
                 elif (
                     not o.startswith(OWL)
                     and not o.startswith(RDF)

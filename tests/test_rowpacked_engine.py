@@ -371,3 +371,50 @@ def test_gated_resume_noop(small):
     full = eng.saturate()
     again = eng.saturate(initial=(full.s, full.r))
     assert again.derivations == 0
+
+
+def test_segmented_row_or_write_decomposition():
+    """write(state, reduce(rows)) must equal apply(state, rows) — the
+    gated step computes the reduce half under a lax.cond and writes
+    unconditionally (OR with zeros is the identity)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from distel_tpu.ops.bitpack import SegmentedRowOr
+
+    rng = np.random.default_rng(7)
+    targets = rng.integers(0, 12, size=23)
+    plan = SegmentedRowOr(targets)
+    state = jnp.asarray(rng.integers(0, 2**32, size=(12, 4), dtype=np.uint32))
+    rows = jnp.asarray(
+        rng.integers(0, 2**32, size=(plan.k, 4), dtype=np.uint32)
+    )
+    out_a, cv_a = plan.apply(state, rows, track="rows")
+    out_w, cv_w = plan.write(state, plan.reduce(rows), track="rows")
+    assert (np.asarray(out_a) == np.asarray(out_w)).all()
+    assert (np.asarray(cv_a) == np.asarray(cv_w)).all()
+    # zero reduced rows are the identity write with an all-false change
+    out_z, cv_z = plan.write(
+        state, jnp.zeros((plan.n_targets, 4), jnp.uint32), track="rows"
+    )
+    assert (np.asarray(out_z) == np.asarray(state)).all()
+    assert not np.asarray(cv_z).any()
+
+
+def test_gated_and_ungated_postures_agree():
+    """The size-adaptive memory posture (gating off + tight chunk budget
+    past the measured single-chip state threshold) must not change
+    semantics: both postures reach the same fixed point."""
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+    from distel_tpu.owl import parser
+
+    norm = normalize(parser.parse(snomed_shaped_ontology(n_classes=600)))
+    idx = index_ontology(norm)
+    gated = RowPackedSaturationEngine(idx, gate_chunks=True).saturate()
+    ungated = RowPackedSaturationEngine(
+        idx, gate_chunks=False, temp_budget_bytes=1 << 28
+    ).saturate()
+    assert gated.derivations == ungated.derivations
+    assert gated.converged and ungated.converged
