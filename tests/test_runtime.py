@@ -621,3 +621,32 @@ def test_embed_state_rejects_shrinking_universe():
     # the saturate(initial=...) path inherits the strict default
     with pytest.raises(ValueError, match="exceeds"):
         rp.saturate(initial=(big_res.packed_s, big_res.packed_r))
+
+
+def test_taxonomy_adaptive_parent_cap():
+    """A class with more direct parents than _PARENT_CAP must stay on
+    the device path: the program re-runs with the cap raised to the next
+    power of two (r1 behavior silently fell back to the host transfer)."""
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+    from distel_tpu.runtime import taxonomy as T
+
+    wide = 100  # > _PARENT_CAP=64, all mutually incomparable
+    corpus = "".join(f"SubClassOf(Hub P{i})\n" for i in range(wide))
+    idx = index_ontology(normalize(parser.parse(corpus)))
+    result = RowPackedSaturationEngine(idx).saturate()
+    orig, names = T._signature(result.idx)
+
+    for extract in (T._extract_device, T._extract_device_blocked):
+        dev = extract(result, orig, names)
+        host = T._extract_host(result, orig, names)
+        assert sorted(dev.parents["Hub"]) == sorted(
+            f"P{i}" for i in range(wide)
+        )
+        assert dev.parents == host.parents
+        assert dev.equivalents == host.equivalents
+    # the public API takes the device path without raising
+    tax = extract_taxonomy(result, method="device")
+    assert len(tax.parents["Hub"]) == wide
