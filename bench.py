@@ -5,14 +5,28 @@ Prints ONE JSON line:
   {"metric": "axiom_derivations_per_sec", "value": N, "unit": "derivations/s",
    "vs_baseline": R, ...}
 
-``vs_baseline`` is the speedup over the single-threaded CPU reference
-saturation (``distel_tpu/core/oracle.py``) on the *same* corpus — the
-stand-in for the reference system's throughput, since the reference
-repository publishes no benchmark numbers (BASELINE.md: "published: {}").
+Headline corpus (r2, per the r1 verdict): the **SNOMED-structured
+many-role corpus at 64k classes** (~88.5k concepts) — the largest corpus
+that runs comfortably on one chip with frontier gating, in the regime the
+reference's own evaluation ontology (SNOMED CT) lives in.  The warm wall
+is ~100x the measured tunnel round trip, so the number is compute-, not
+latency-dominated.  Secondary figures:
 
-Corpus: deterministic GALEN-shaped synthetic EL+ ontology exercising all
-of CR1-CR6 (hierarchy, n-ary conjunctions, existentials, role hierarchy,
-transitive partonomy, right-identity chain, domain/range).
+* the GALEN-shaped 16k corpus — the latency-sensitivity probe (small
+  enough that the tunnel RTT is a visible fraction of the wall);
+* ``vs_baseline_converged`` — the speedup against the single-threaded
+  CPU oracle at a size where the oracle actually FINISHES (the primary
+  ``vs_baseline`` uses a time-budgeted oracle run, disclosed as such,
+  because the sequential baseline needs hours at the headline size);
+* a roofline section from the engine's static plan shapes: per-step HBM
+  traffic and utilization, and the CR4/CR6 dense-equivalent matmul
+  throughput vs the MXU's dense int8 peak (above 1.0 means the
+  tile-skipping kernel beats running the contraction dense).
+
+``vs_baseline`` is the speedup over the CPU reference saturation
+(``distel_tpu/core/oracle.py``) on the *same* corpus — the stand-in for
+the reference system's throughput, since the reference repository
+publishes no benchmark numbers (BASELINE.md: "published: {}").
 """
 
 import json
@@ -31,11 +45,26 @@ from distel_tpu.core.indexing import index_ontology  # noqa: E402
 from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine  # noqa: E402
 from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
 
+#: v5e per-chip peaks (public spec): 394 TOPS int8, 819 GB/s HBM BW
+_V5E_INT8_OPS = 394e12
+_V5E_HBM_BPS = 819e9
+
 
 def _timed(f) -> float:
     t0 = time.time()
     f()
     return time.time() - t0
+
+
+def _saturate_timed(engine):
+    """(result, cold_s, warm_s): cold = compile + run, warm = best of 3
+    steady-state fixed points (never a repeat-call cache artifact: each
+    saturate() rebuilds fresh initial state and runs the full loop)."""
+    t0 = time.time()
+    result = engine.saturate()
+    cold_s = time.time() - t0
+    warm_s = min(_timed(engine.saturate) for _ in range(3))
+    return result, cold_s, warm_s
 
 
 def main() -> None:
@@ -44,29 +73,15 @@ def main() -> None:
     from distel_tpu.config import enable_compile_cache
 
     enable_compile_cache()
-    # 16k is the measured throughput sweet spot on one v5e core: small
-    # enough that the CPU-baseline run stays in budget, large enough that
-    # compute dominates the ~117 ms tunnel round-trip of a warm call
-    n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
-    text = synthetic_ontology(
-        n_classes=n_classes,
-        n_anatomy=max(200, n_classes // 10),
-        n_locations=max(150, n_classes // 12),
-        n_definitions=max(100, n_classes // 20),
-    )
+    n_classes = int(sys.argv[1]) if len(sys.argv) > 1 else 64000
+    custom = len(sys.argv) > 1
+
+    # ---- primary: SNOMED-structured many-role corpus ----
+    text = snomed_shaped_ontology(n_classes=n_classes)
     norm = normalize(parser.parse(text))
     idx = index_ontology(norm)
-
     engine = RowPackedSaturationEngine(idx)
-    # cold run = compile + execute; warm = best of 3 steady-state runs
-    # (each warm call pays one host->device round trip, which is noisy
-    # over the remote tunnel)
-    t0 = time.time()
-    result = engine.saturate()
-    cold_s = time.time() - t0
-    warm_s = min(
-        _timed(engine.saturate) for _ in range(3)
-    )
+    result, cold_s, warm_s = _saturate_timed(engine)
     engine_dps = result.derivations / warm_s
 
     # measured tunnel round-trip (a trivial device call), so readers can
@@ -80,32 +95,69 @@ def main() -> None:
         for _ in range(5)
     )
 
-    # CPU reference baseline on the same corpus — time-bounded: the
-    # sequential oracle takes minutes at this size, and its throughput
-    # only FALLS as saturation proceeds (early iterations derive the
-    # cheap bulk), so a budget-capped derivations/s reads in the
-    # baseline's favor while keeping the bench bounded
+    # ---- roofline from static plan shapes ----
+    # step_cost_model() counts the UNGATED step (frontier gating skips
+    # chunks in late supersteps), so both rates are labeled
+    # dense-equivalent: the work a naive dense/ungated program would
+    # have to move per measured second.  Values above 1.0x peak mean
+    # the skipping logic beats brute force, not that silicon overclocked.
+    cost = engine.step_cost_model()
+    steps = result.iterations
+    sec_per_step = warm_s / max(steps, 1)
+    hbm_bps = cost["hbm_bytes"] / sec_per_step
+    mm_ops = 2.0 * cost["mm_dense_equiv_macs"] / sec_per_step
+    roofline = {
+        "hbm_bytes_per_step_ungated": cost["hbm_bytes"],
+        "hbm_gbps_dense_equiv": round(hbm_bps / 1e9, 1),
+        "mm_dense_equiv_tops": round(mm_ops / 1e12, 2),
+    }
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        roofline["hbm_dense_equiv_vs_peak"] = round(
+            hbm_bps / _V5E_HBM_BPS, 3
+        )
+        roofline["mm_dense_equiv_vs_int8_peak"] = round(
+            mm_ops / _V5E_INT8_OPS, 2
+        )
+
+    # ---- budget-capped baseline on the primary corpus ----
     t0 = time.time()
     oracle_result = cpu_oracle.saturate(norm, time_budget_s=90.0)
     oracle_s = time.time() - t0
     oracle_dps = oracle_result.derivation_count() / oracle_s
 
-    # secondary figure (default invocations only — a custom size means a
-    # quick targeted run): the SNOMED-structured corpus, the many-role
-    # regime of the reference's own evaluation ontology; exercises the
-    # role-clustered tile-sparse matmul path
-    snomed_fields = {}
-    if len(sys.argv) <= 1:
-        stext = snomed_shaped_ontology(n_classes=24000)
-        sidx = index_ontology(normalize(parser.parse(stext)))
-        sengine = RowPackedSaturationEngine(sidx)
-        sres = sengine.saturate()
-        s_warm = min(_timed(sengine.saturate) for _ in range(3))
-        snomed_fields = {
-            "snomed_shaped_24k_concepts": sidx.n_concepts,
-            "snomed_shaped_24k_wall_s_warm": round(s_warm, 3),
-            "snomed_shaped_24k_dps": round(sres.derivations / s_warm, 1),
-        }
+    extra = {}
+    if not custom:
+        # ---- converged baseline at a size the oracle finishes ----
+        ctext = snomed_shaped_ontology(n_classes=3000)
+        cnorm = normalize(parser.parse(ctext))
+        cidx = index_ontology(cnorm)
+        cengine = RowPackedSaturationEngine(cidx)
+        cres, _, c_warm = _saturate_timed(cengine)
+        t0 = time.time()
+        coracle = cpu_oracle.saturate(cnorm, time_budget_s=600.0)
+        c_oracle_s = time.time() - t0
+        if coracle.converged:
+            extra["vs_baseline_converged"] = round(
+                (cres.derivations / c_warm)
+                / (coracle.derivation_count() / c_oracle_s),
+                2,
+            )
+            extra["baseline_converged_n_concepts"] = cidx.n_concepts
+
+        # ---- latency-sensitivity probe: GALEN-shaped 16k ----
+        gtext = synthetic_ontology(
+            n_classes=16000, n_anatomy=1600, n_locations=1333,
+            n_definitions=800,
+        )
+        gidx = index_ontology(normalize(parser.parse(gtext)))
+        gengine = RowPackedSaturationEngine(gidx)
+        gres, _, g_warm = _saturate_timed(gengine)
+        extra.update(
+            galen_16k_concepts=gidx.n_concepts,
+            galen_16k_wall_s_warm=round(g_warm, 3),
+            galen_16k_dps=round(gres.derivations / g_warm, 1),
+        )
 
     print(
         json.dumps(
@@ -115,6 +167,7 @@ def main() -> None:
                 "unit": "derivations/s",
                 "vs_baseline": round(engine_dps / oracle_dps, 2),
                 "platform": jax.devices()[0].platform,
+                "corpus": f"snomed_shaped_{n_classes // 1000}k",
                 "n_concepts": idx.n_concepts,
                 "n_links": idx.n_links,
                 "derivations": result.derivations,
@@ -125,7 +178,8 @@ def main() -> None:
                 "baseline_cpu_dps": round(oracle_dps, 1),
                 "baseline_budget_s": 90.0,
                 "baseline_converged": oracle_result.converged,
-                **snomed_fields,
+                **roofline,
+                **extra,
             }
         )
     )

@@ -80,7 +80,6 @@ from distel_tpu.ops.bitpack import (
     SegmentedRowOr,
     bit_lookup,
     bit_lookup_from,
-    unpack_words,
 )
 
 
@@ -266,36 +265,32 @@ class RowPackedSaturationEngine:
             fillers[: idx.n_links] = idx.links[:, 1]
         self._fillers = fillers
 
-        # The closure masks are stored BIT-PACKED along the link axis
-        # ([K, nl/32] u32 — byte-wide masks would be 5 GB at the 96k
-        # many-role scale) and unpacked one L-chunk at a time in the
-        # step; they are device arrays passed as *arguments* to the
-        # jitted run — embedded as program constants they get serialized
-        # into every (remote) compile request, which breaks past ~100 MB.
-        def packed_mask(roles: np.ndarray) -> np.ndarray:
-            """rows[j, l] = H[role(l), roles[j]], bit-packed along l.
-            Built in row blocks: the full byte-wide mask is the multi-GB
-            allocation the packing exists to avoid."""
-            out = np.zeros((len(roles), self.nl // 32), np.uint32)
-            hl = h[link_roles]                      # [n_links, n_roles]
-            for j0 in range(0, len(roles), 4096):
-                rs = roles[j0 : j0 + 4096]
-                m = np.zeros((len(rs), self.nl), bool)
-                m[:, : idx.n_links] = hl[:, rs].T
-                out[j0 : j0 + 4096] = np.ascontiguousarray(
-                    np.packbits(m, axis=1, bitorder="little")
-                ).view(np.uint32)
-            return out
+        # The CR4/CR6 closure masks are FACTORED, never materialized:
+        # mask[j, l] = H[role(l), s_j] depends on l only through role(l),
+        # so the step gathers one [rk, lc] tile per L-chunk from a
+        # [K, n_roles+1] table (h[j, ρ] = H[ρ, s_j], one extra all-zero
+        # sentinel role for padded links).  Round 1 stored the mask
+        # bit-packed along the link axis ([K, nl/32] u32) — 8.6 GB at
+        # the 300k-class SNOMED shape, REPLICATED per shard under the
+        # word-axis sharding; the factored tables are ~15 MB there.
+        # They stay *arguments* to the jitted run (embedded constants
+        # get serialized into every remote compile request).
+        n_roles = h.shape[0]
+        h2 = np.zeros((n_roles + 1, n_roles), np.int8)
+        h2[:n_roles] = h
+        self._link_roles = np.full(self.nl, n_roles, np.int32)  # sentinel
+        if idx.n_links:
+            self._link_roles[: idx.n_links] = link_roles
 
-        m4 = np.zeros((0, 0), np.uint32)
+        m4 = np.zeros((0, n_roles + 1), np.int8)
         if self._p4 is not None:
-            # m4[j, l] = H[role(l), s_j] — the link's role must be a
+            # m4[j, ρ] = H[ρ, s_j] — the link's role must be a
             # (transitive) subrole of the axiom's s
-            m4 = packed_mask(idx.nf4[:, 0])
-        m6 = np.zeros((0, 0), np.uint32)
+            m4 = np.ascontiguousarray(h2[:, idx.nf4[:, 0]].T)
+        m6 = np.zeros((0, n_roles + 1), np.int8)
         if self._p6 is not None:
-            # m6[p, l] = H[role(l), r_p] — first-leg subrole closure
-            m6 = packed_mask(idx.chain_pairs[:, 0])
+            # m6[p, ρ] = H[ρ, r_p] — first-leg subrole closure
+            m6 = np.ascontiguousarray(h2[:, idx.chain_pairs[:, 0]].T)
         self._masks = (jnp.asarray(m4), jnp.asarray(m6))
 
         # one packed-output matmul plan per row-chunk, shared by every
@@ -598,6 +593,43 @@ class RowPackedSaturationEngine:
         n = self._gate["n_flags"] if self._gate else 0
         return jnp.ones(max(n, 1), bool)
 
+    def step_cost_model(self) -> dict:
+        """Analytic per-superstep cost from the static plan shapes, for
+        roofline reporting (SURVEY §6 / BASELINE.md ask throughput to be
+        relatable to what the chip could do):
+
+        * ``hbm_bytes`` — packed-state HBM traffic of one ungated
+          superstep: per rule, source-row gathers + target-row
+          read-modify-writes (CR1-CR3), the per-chunk R_T sweep +
+          bit-table gathers of the L-loop (CR4/CR6), and the CR5
+          OR-reduce sweep.  Gating only reduces this, so the figure is
+          an upper bound per step.
+        * ``mm_dense_equiv_macs`` — the CR4/CR6 contraction size as a
+          DENSE matmul ([Σrk, nl] @ [nl, nc]): the dense-equivalent
+          work the tile-skipping kernel competes against; achieved
+          ops/s above the MXU's dense peak means the skip logic is
+          winning, not that silicon broke physics.
+        """
+        w4 = 4 * self.wc  # bytes per packed row
+        rw = 0
+        for plans in (self._cr1_chunks, self._cr3_chunks):
+            for sl, piece in plans:
+                rw += (sl.stop - sl.start) * w4          # gathered sources
+                rw += 2 * piece.n_targets * w4           # target RMW
+        for sl, piece in self._cr2_chunks:
+            rw += 2 * (sl.stop - sl.start) * w4
+            rw += 2 * piece.n_targets * w4
+        macs = 0
+        for chunks in (self._cr4_chunks, self._cr6_chunks):
+            for raw, _inv, piece in chunks:
+                rw += self.nl * w4                       # full R_T sweep
+                rw += len(raw) * w4                      # subt gather
+                rw += 2 * piece.n_targets * w4           # target RMW
+                macs += len(raw) * self.nl * self.nc
+        if self._bottom:
+            rw += (self.nl + 2) * w4
+        return {"hbm_bytes": rw, "mm_dense_equiv_macs": macs}
+
     def _next_dirty(self, s_vecs, r_vecs, axis_name):
         """End-of-step flag computation from the writers' change
         vectors; one tiny psum makes the flags globally uniform under
@@ -722,8 +754,8 @@ class RowPackedSaturationEngine:
         # matmul contracts over the chunk's unique raw axioms and OR-
         # accumulates over L-chunks inside a ``fori_loop`` (partial
         # AND-OR products just OR; sequencing bounds peak memory to one
-        # chunk's temporaries — see __init__).  Per chunk the bit-packed
-        # mask slice unpacks to [rk, Lc] i8.  The packed output rows are
+        # chunk's temporaries — see __init__).  Per chunk the factored
+        # role mask gathers to a [rk, Lc] i8 tile.  The packed output rows are
         # then gathered into the seg-OR's repeat-padded emission order
         # (packed-row copies are ~free next to MXU work)
         dt = self.matmul_dtype
@@ -732,6 +764,7 @@ class RowPackedSaturationEngine:
         fillers2d = jnp.asarray(
             self._fillers.reshape(self.n_lchunks, lc).astype(np.int32)
         )
+        lr2d = jnp.asarray(self._link_roles.reshape(self.n_lchunks, lc))
         base = (
             None
             if axis_name is None
@@ -753,10 +786,8 @@ class RowPackedSaturationEngine:
                         ),
                         axis_name,
                     ).astype(dt)                          # [lc, rk]
-                mw = lax.dynamic_slice(
-                    mask_rows, (0, i * (lc // 32)), (rk, lc // 32)
-                )
-                w = unpack_words(mw, lc, dtype=dt) * f.T  # [rk, lc]
+                # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
+                w = jnp.take(mask_rows, lr2d[i], axis=1).astype(dt) * f.T
                 b = lax.dynamic_slice(rp_state, (i * lc, 0), (lc, wlw))
                 return acc | mm(w, b)
 

@@ -26,8 +26,10 @@ Two paths:
   walking the reduced DAG, only if someone reads it.
 * **host**: the original numpy implementation, used as fallback past
   the blocked cap and as the reference in tests.  Parent counts beyond
-  ``_PARENT_CAP`` no longer fall back: the device program re-runs with
-  an adaptively raised cap (next power of two over the measured max).
+  ``_PARENT_CAP`` stay on device: the program re-runs with an adaptively
+  raised cap (next power of two over the measured max), falling back to
+  the host only past ``_ADAPTIVE_CAP_MAX`` (adversarially flat
+  taxonomies, where the pidx transfer would grow toward O(n²)).
 """
 
 from __future__ import annotations
@@ -47,6 +49,10 @@ from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID
 #: the 48k-class SNOMED-shaped corpus: max direct parents = 3, so the
 #: first attempt always suffices for realistic taxonomies.
 _PARENT_CAP = 64
+#: widest parent set the adaptive re-run will serve on device; past this
+#: (an adversarially flat taxonomy) the pidx transfer and top-k grow
+#: toward O(n²) and the host path degrades more gracefully
+_ADAPTIVE_CAP_MAX = 4096
 #: signature size up to which the simple dense device program is used:
 #: peak HBM ≈ 10·n² bytes (two int32 [n, n] temporaries — the reduction
 #: matmul output and the tie-broken top-k operand — plus the live
@@ -175,8 +181,17 @@ def extract_taxonomy(
     if method == "auto" and len(orig) > _DEVICE_BLOCKED_N_CAP:
         return _extract_host(result, orig, names)
     if len(orig) > _DEVICE_N_CAP:
-        return _extract_device_blocked(result, orig, names)
-    return _extract_device(result, orig, names)
+        got = _extract_device_blocked(result, orig, names)
+    else:
+        got = _extract_device(result, orig, names)
+    if got is None:  # adversarially wide: past the adaptive-cap ceiling
+        if method == "device":
+            raise ValueError(
+                f"device taxonomy would need more than {_ADAPTIVE_CAP_MAX} "
+                f"direct parents per class; use method='host'"
+            )
+        return _extract_host(result, orig, names)
+    return got
 
 
 # ------------------------------------------------------------- device path
@@ -257,26 +272,33 @@ def _assemble(orig, names, canon, unsat, counts, pidx) -> Taxonomy:
     return Taxonomy(None, equivalents, parents, unsat_names)
 
 
-def _run_adaptive(make_run, result, orig, names) -> Taxonomy:
+def _run_adaptive(make_run, result, orig, names) -> Optional[Taxonomy]:
     """Run a device taxonomy program, re-running with the parent cap
     raised to the next power of two above the measured maximum when the
-    first attempt overflows (bounds recompiles at log2(n)) — the r1
-    behavior fell back to the host, whose cost at scale is exactly the
-    bulk closure transfer the device path exists to avoid.  ``counts``
-    is fetched alone first so an overflowing attempt never pays the
-    [n, cap] pidx transfer over the (slow, remote-attached) tunnel."""
+    first attempt overflows (at most one re-run: counts are
+    cap-independent) — the r1 behavior fell back to the host, whose
+    cost at scale is exactly the bulk closure transfer the device path
+    exists to avoid.  All outputs are fetched together (the overflow
+    case wastes one small [n, cap] transfer, but it is rare — measured
+    max direct parents on the 48k SNOMED-shaped corpus is 3 — and a
+    counts-first probe would cost every happy-path call an extra tunnel
+    round trip).  Returns None past ``_ADAPTIVE_CAP_MAX``: an
+    adversarially flat taxonomy would need an O(n·cap) pidx transfer
+    (and top-k) that the host path handles more gracefully."""
     cap = _PARENT_CAP
     while True:
         out = make_run(cap)(result.packed_s)
-        counts = np.asarray(fetch_global(out[2]))
+        canon, unsat, counts, pidx = fetch_global(out)
+        counts = np.asarray(counts)
         mx = int(counts.max(initial=0))
         if mx <= cap or cap >= len(orig):
-            canon, unsat, pidx = fetch_global((out[0], out[1], out[3]))
             return _assemble(orig, names, canon, unsat, counts, pidx)
+        if mx > _ADAPTIVE_CAP_MAX:
+            return None
         cap = 1 << (mx - 1).bit_length()
 
 
-def _extract_device(result, orig, names) -> Taxonomy:
+def _extract_device(result, orig, names) -> Optional[Taxonomy]:
     obytes = np.asarray(orig, np.int64).tobytes()
     return _run_adaptive(
         lambda cap: _device_program(obytes, bool(result.transposed), cap),
@@ -416,7 +438,7 @@ def _device_blocked_program(
     return jax.jit(run)
 
 
-def _extract_device_blocked(result, orig, names) -> Taxonomy:
+def _extract_device_blocked(result, orig, names) -> Optional[Taxonomy]:
     obytes = np.asarray(orig, np.int64).tobytes()
     return _run_adaptive(
         lambda cap: _device_blocked_program(
