@@ -54,6 +54,16 @@ class OracleResult:
             len(v) for v in self.role_pairs.values()
         )
 
+    def derived_count(self) -> int:
+        """Facts beyond the S(X)={X,⊤} initialization — the unit the
+        engines' ``derivations`` field uses (total bits − init bits), so
+        engine/oracle throughput ratios compare like with like.  The
+        init holds 2 facts per atom except ⊤ itself ({⊤} only)."""
+        init = 2 * len(self.subsumers) - (
+            1 if S.OWL_THING in self.subsumers else 0
+        )
+        return max(self.derivation_count() - init, 0)
+
 
 def saturate(
     norm: NormalizedOntology,

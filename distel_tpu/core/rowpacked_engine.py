@@ -156,7 +156,14 @@ class RowPackedSaturationEngine:
         # round 1's single-chip ceiling) the engine drops to the tight
         # budget and disables gating unless the caller pinned either.
         state_bytes = (self.nc + self.nl) * self.wc * 4 // max(self.n_shards, 1)
-        large = state_bytes > (5 << 29)
+        # mesh runs tip earlier: the cond pass-through copies scale with
+        # the per-shard state and the 16 GB v5e budget must also hold the
+        # replicated plan constants (measured: 200k-class/8-shard at
+        # 2.06 GB per-shard state compiled to 14.1 GB gated temp vs well
+        # under that ungated)
+        large = state_bytes > (
+            (3 << 29) if mesh is not None else (5 << 29)
+        )
         if temp_budget_bytes is None:
             temp_budget_bytes = (1 << 28) if large else (1 << 29)
         if gate_chunks is None and large:

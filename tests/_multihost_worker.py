@@ -2,14 +2,16 @@
 runtime through the framework's own bootstrap, run the sharded fixed
 point over the global mesh, print a result line the test asserts on.
 
-Run as: python tests/_multihost_worker.py <coordinator> <pid> <nproc>
+Run as: python tests/_multihost_worker.py <coordinator> <pid> <nproc> [n_classes]
 with JAX_PLATFORMS=cpu and xla_force_host_platform_device_count set by
 the spawner.
 """
 
 import sys
+import time
 
 coordinator, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+n_classes = int(sys.argv[4]) if len(sys.argv) > 4 else 400
 
 from distel_tpu.parallel.mesh import build_mesh, init_distributed  # noqa: E402
 
@@ -26,9 +28,17 @@ from distel_tpu.frontend.normalizer import normalize  # noqa: E402
 from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology  # noqa: E402
 from distel_tpu.owl import parser  # noqa: E402
 
-text = snomed_shaped_ontology(n_classes=400, n_roles=24)
+text = snomed_shaped_ontology(n_classes=n_classes, n_roles=24)
 idx = index_ontology(normalize(parser.parse(text)))
-res = RowPackedSaturationEngine(idx, mesh=mesh).saturate()
+engine = RowPackedSaturationEngine(idx, mesh=mesh)
+res = engine.saturate()  # cold: compile + run
+# warm wall of the distributed fixed point — the number that makes the
+# cross-process (DCN-analog) overhead visible next to the single-process
+# wall printed by pid 0 below (reference scale story:
+# scripts/classify-all.sh pssh fan-out)
+t0 = time.time()
+res = engine.saturate()
+mesh_warm_s = time.time() - t0
 
 # full-closure comparison, not just counts: res.s goes through the
 # collective allgather fetch (every process participates), and proc 0
@@ -39,8 +49,13 @@ n, nl = idx.n_concepts, idx.n_links
 mesh_closure = (res.s[:n, :n].tobytes(), res.r[:n, :nl].tobytes())
 digest = hashlib.sha256(mesh_closure[0] + mesh_closure[1]).hexdigest()[:16]
 closure_match = "n/a"
+local_warm_s = -1.0
 if pid == 0:
-    local = RowPackedSaturationEngine(idx).saturate()
+    local_engine = RowPackedSaturationEngine(idx)
+    local = local_engine.saturate()
+    t0 = time.time()
+    local = local_engine.saturate()
+    local_warm_s = time.time() - t0
     closure_match = bool(
         local.derivations == res.derivations
         and local.s[:n, :n].tobytes() == mesh_closure[0]
@@ -49,6 +64,7 @@ if pid == 0:
 print(
     f"MULTIHOST pid={pid} shards={mesh.shape['c']} "
     f"derivations={res.derivations} digest={digest} "
-    f"closure_match={closure_match}",
+    f"closure_match={closure_match} "
+    f"mesh_warm_s={mesh_warm_s:.2f} local_warm_s={local_warm_s:.2f}",
     flush=True,
 )
