@@ -235,20 +235,33 @@ def fetch_global(tree):
     return jax.tree_util.tree_map(get, tree)
 
 
+def fresh_init_total(idx: IndexedOntology) -> int:
+    """Live bits of the S(X)={X,⊤} initial state: one diagonal bit per
+    live concept plus the full ⊤ row, overlapping at (⊤, ⊤).  Used so
+    ``saturate`` never computes the init count INSIDE the donated run
+    program: with buffer donation + memory-pressure rematerialization
+    the tunnel XLA was observed (96k many-role corpus) to alias the
+    early init-count buffer onto the in-place loop state, returning the
+    FINAL count twice and reporting zero derivations."""
+    return 2 * idx.n_concepts - 1
+
+
 def finish_device_run(
     out,
     idx: IndexedOntology,
     budget: int,
     allow_incomplete: bool,
     transposed: bool,
+    init_total: int,
 ) -> "SaturationResult":
     """Shared epilogue of the packed engines' ``saturate``: ``out`` is
-    ``(sp, rp, iteration, changed, bits, init_bits)`` where the scalars
+    ``(sp, rp, iteration, changed, bits)`` where the scalars
     may carry one lane per shard.  Fetches only scalars and per-row
     counts — the packed closure stays device-resident until someone reads
-    it (``SaturationResult._fetch``)."""
+    it (``SaturationResult._fetch``).  ``init_total`` is computed by the
+    caller OUTSIDE the run program (see :func:`fresh_init_total`)."""
     sp, rp = out[0], out[1]
-    it, changed, bits, init_bits = fetch_global(out[2:])
+    it, changed, bits = fetch_global(out[2:])
     it, changed = np.max(it), np.max(changed)
     converged = not bool(changed)
     if not converged and not allow_incomplete:
@@ -259,7 +272,7 @@ def finish_device_run(
         packed_s=sp,
         packed_r=rp,
         iterations=int(it),
-        derivations=_host_bit_total(bits) - _host_bit_total(init_bits),
+        derivations=_host_bit_total(bits) - init_total,
         idx=idx,
         converged=converged,
         transposed=transposed,
@@ -351,6 +364,7 @@ class SaturationEngine:
 
         self._step_jit = jax.jit(self._step)
         self._observe_jit = None
+        self._live_bits_jit = None
         self._pack_jit = jax.jit(_pack_bits)
         self._initial_jit = None
         self._run_fresh_jit = jax.jit(self._run_fresh, static_argnums=(0,))
@@ -510,17 +524,15 @@ class SaturationEngine:
             bits=self._live_bits(final.s, final.r),
         )
 
-    def _run_fresh(self, max_iters: int) -> Tuple[_RunOutput, jax.Array]:
+    def _run_fresh(self, max_iters: int) -> _RunOutput:
         s0, r0 = self._initial_arrays()
-        init_bits = self._live_bits(s0, r0)
-        return self._fixed_point(s0, r0, max_iters), init_bits
+        return self._fixed_point(s0, r0, max_iters)
 
     def _run_from(
         self, state: Tuple[jax.Array, jax.Array], max_iters: int
-    ) -> Tuple[_RunOutput, jax.Array]:
+    ) -> _RunOutput:
         s0, r0 = state
-        init_bits = self._live_bits(s0, r0)
-        return self._fixed_point(s0, r0, max_iters), init_bits
+        return self._fixed_point(s0, r0, max_iters)
 
     def _observe_round(
         self, s: jax.Array, r: jax.Array
@@ -592,19 +604,27 @@ class SaturationEngine:
         uint32 arrays and three scalars."""
         # round the iteration budget up to a whole number of unrolled bodies
         budget = _pad_up(max_iters, self.unroll)
+        # the init count is never computed inside the (donated) run
+        # program — see fresh_init_total; fresh runs use the analytic
+        # count, resumes pay one eager live-bits round trip
         if initial is None:
-            out, init_bits = self._run_fresh_jit(budget)
+            init_total = fresh_init_total(self.idx)
+            out = self._run_fresh_jit(budget)
         else:
-            out, init_bits = self._run_from_jit(
-                self.embed_state(*initial), budget
+            state = self.embed_state(*initial)
+            if self._live_bits_jit is None:
+                self._live_bits_jit = jax.jit(self._live_bits)
+            init_total = _host_bit_total(
+                fetch_global(self._live_bits_jit(*state))
             )
+            out = self._run_from_jit(state, budget)
         # exactly one host sync for the whole run — scalars and per-row
         # counts only; the packed closure stays on device until someone
         # actually reads it (SaturationResult._fetch)
-        iteration, changed, bits, init_bits = fetch_global(
-            (out.iteration, out.changed, out.bits, init_bits)
+        iteration, changed, bits = fetch_global(
+            (out.iteration, out.changed, out.bits)
         )
-        derivations = _host_bit_total(bits) - _host_bit_total(init_bits)
+        derivations = _host_bit_total(bits) - init_total
         return self._finish(
             out.packed_s, out.packed_r, int(iteration), derivations,
             not bool(changed), allow_incomplete, budget,

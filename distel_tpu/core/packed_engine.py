@@ -48,9 +48,12 @@ from jax import lax
 
 from distel_tpu.core.engine import (
     SaturationResult,
-    check_embed_fits,
+    _host_bit_total,
     _pad_up,
+    check_embed_fits,
+    fetch_global,
     finish_device_run,
+    fresh_init_total,
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.ops.bitmatmul import PackedMatmulPlan
@@ -179,6 +182,7 @@ class PackedSaturationEngine:
             self._row_sharding = None
         self._step_jit = jax.jit(self._step)
         self._initial_jit = None
+        self._live_bits_jit = None
         if mesh is None:
             self._run_jit = jax.jit(self._run, static_argnums=(2,))
         else:
@@ -324,11 +328,10 @@ class PackedSaturationEngine:
                 changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
             return (sp2, rp2, it + unroll, changed)
 
-        init_bits = self._live_bits(sp0, rp0, axis_name)
         sp, rp, it, changed = lax.while_loop(
             cond, body, (sp0, rp0, jnp.asarray(0, jnp.int32), jnp.asarray(True))
         )
-        return sp, rp, it, changed, self._live_bits(sp, rp, axis_name), init_bits
+        return sp, rp, it, changed, self._live_bits(sp, rp, axis_name)
 
     def _sharded_run(self, max_iters: int):
         """Build (and cache per iteration budget) the jitted shard_map of
@@ -337,20 +340,11 @@ class PackedSaturationEngine:
         axis = self.concept_axis
 
         def run(sp0, rp0):
-            sp, rp, it, changed, bits, init_bits = self._run(
-                sp0, rp0, max_iters, axis
-            )
+            sp, rp, it, changed, bits = self._run(sp0, rp0, max_iters, axis)
             # scalars leave the shard_map as one lane per shard (their
             # values are replicated by construction — psum'd vote,
             # lockstep counter)
-            return (
-                sp,
-                rp,
-                it[None],
-                changed[None],
-                bits,
-                init_bits,
-            )
+            return sp, rp, it[None], changed[None], bits
 
         return jax.jit(
             jax.shard_map(
@@ -360,7 +354,6 @@ class PackedSaturationEngine:
                 out_specs=(
                     P(axis, None),
                     P(axis, None),
-                    P(axis),
                     P(axis),
                     P(axis),
                     P(axis),
@@ -379,14 +372,21 @@ class PackedSaturationEngine:
         budget = _pad_up(max_iters, self.unroll)
         if initial is None:
             sp0, rp0 = self.initial_state()
+            init_total = fresh_init_total(self.idx)
         else:
             sp0, rp0 = self.embed_state(*initial)
+            if self._live_bits_jit is None:
+                self._live_bits_jit = jax.jit(self._live_bits)
+            init_total = _host_bit_total(
+                fetch_global(self._live_bits_jit(sp0, rp0))
+            )
         if self.mesh is None:
             out = self._run_jit(sp0, rp0, budget)
         else:
             out = self._run_jit(budget)(sp0, rp0)
         return finish_device_run(
-            out, self.idx, budget, allow_incomplete, transposed=False
+            out, self.idx, budget, allow_incomplete, transposed=False,
+            init_total=init_total,
         )
 
     def embed_state(

@@ -72,6 +72,7 @@ from distel_tpu.core.engine import (
     _pad_up,
     fetch_global,
     finish_device_run,
+    fresh_init_total,
     observed_loop,
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
@@ -331,6 +332,45 @@ class RowPackedSaturationEngine:
         if gate_chunks is None:
             gate_chunks = self.nc >= 32_768
         self._gate = self._build_gate() if gate_chunks else None
+
+        # ---- L-frontier bookkeeping: the two-sided semi-naive join of
+        # the reference (base/Type3_2AxiomProcessorBase.java:100-174 —
+        # part 1 re-joins keys whose B-side grew, part 2 keys whose
+        # R-side grew) in tensor form.  Each CR4/CR6 L-iteration's
+        # contribution is OR-monotone, so it only needs re-contracting
+        # when one of its inputs changed since it last ran:
+        #   * an R row inside that L-chunk           (dirty_l[i]), or
+        #   * a bit-table source row — S rows a4[raw] for CR4 (exact,
+        #     via the changed-S-row mask), R rows l2[raw] for CR6
+        #     (conservative at L-chunk granularity).
+        # A stale iteration's w operand is multiplied to zero, which the
+        # Pallas kernel's per-tile skip flags turn into skipped MXU work
+        # — no lax.cond, so no state- or acc-valued branch copies.
+        # Skipped contributions are only DELAYED: flags are folded from
+        # this step's write change-vectors, and the fixed point exits
+        # only after a full no-change step, so convergence detection is
+        # unaffected.
+        def _concat_or_empty(parts, dtype=np.int64):
+            parts = [np.asarray(p, dtype) for p in parts if len(p)]
+            return (
+                np.concatenate(parts) if parts else np.zeros(0, dtype)
+            )
+
+        self._s_fold_targets = _concat_or_empty(
+            [piece.targets for _, piece in self._cr1_chunks]
+            + [piece.targets for _, piece in self._cr2_chunks]
+            + [piece.targets for _, _, piece in self._cr4_chunks]
+            + ([np.full(1, BOTTOM_ID)] if self._bottom else [])
+        )
+        self._r_fold_chunks = _concat_or_empty(
+            [piece.targets for _, piece in self._cr3_chunks]
+            + [piece.targets for _, _, piece in self._cr6_chunks]
+        ) // self.lc
+        self._l2chunks6 = [
+            np.unique(self._l26[raw] // self.lc)
+            for raw, _, _ in self._cr6_chunks
+        ]
+        self._a4rows = [self._a4[raw] for raw, _, _ in self._cr4_chunks]
 
         if mesh is not None:
             P = jax.sharding.PartitionSpec
@@ -595,10 +635,16 @@ class RowPackedSaturationEngine:
             "n_flags": len(readers),
         }
 
-    def initial_dirty(self) -> jax.Array:
-        """All-dirty flags (every chunk runs on the first superstep)."""
+    def initial_dirty(self):
+        """All-dirty frontier carry (everything runs on the first
+        superstep): ``(rule-chunk gate flags, per-L-chunk R dirty flags,
+        changed-S-row mask)``."""
         n = self._gate["n_flags"] if self._gate else 0
-        return jnp.ones(max(n, 1), bool)
+        return (
+            jnp.ones(max(n, 1), bool),
+            jnp.ones(max(self.n_lchunks, 1), bool),
+            jnp.ones(self.nc, bool),
+        )
 
     def step_cost_model(self) -> dict:
         """Analytic per-superstep cost from the static plan shapes, for
@@ -677,6 +723,27 @@ class RowPackedSaturationEngine:
             dirty = lax.psum(dirty.astype(jnp.int32), axis_name) > 0
         return dirty
 
+    def _next_frontier(self, s_vecs, r_vecs, axis_name):
+        """Fold this step's write change-vectors into the next step's
+        L-frontier: (per-L-chunk R dirty flags, changed-S-row mask).
+        Cheap static scatters — the vectors are already aligned with the
+        plans' target rows in rule order; a psum keeps the flags uniform
+        across shards (cv is computed on each shard's word slice)."""
+        s_changed = jnp.zeros(self.nc, bool)
+        if len(self._s_fold_targets) and s_vecs:
+            cv = jnp.concatenate([v.astype(bool) for v in s_vecs])
+            s_changed = s_changed.at[
+                jnp.asarray(self._s_fold_targets)
+            ].max(cv)
+        dirty_l = jnp.zeros(max(self.n_lchunks, 1), bool)
+        if len(self._r_fold_chunks) and r_vecs:
+            cv = jnp.concatenate([v.astype(bool) for v in r_vecs])
+            dirty_l = dirty_l.at[jnp.asarray(self._r_fold_chunks)].max(cv)
+        if axis_name is not None:
+            dirty_l = lax.psum(dirty_l.astype(jnp.int32), axis_name) > 0
+            s_changed = lax.psum(s_changed.astype(jnp.int32), axis_name) > 0
+        return dirty_l, s_changed
+
     def _step(
         self,
         sp: jax.Array,
@@ -695,8 +762,9 @@ class RowPackedSaturationEngine:
         loop carries two full copies of S and OOMs ~2x earlier."""
         m4, m6 = self._masks if masks is None else masks
         gating = self._gate is not None
-        if gating and dirty is None:  # stateless public step(): all-dirty
+        if dirty is None:  # stateless public step(): all-dirty
             dirty = self.initial_dirty()
+        gate_flags, dirty_l, s_changed = dirty
         ch = jnp.asarray(False)
         s_vecs, r_vecs = [], []
         flag = iter(range(self._gate["n_flags"])) if gating else None
@@ -717,7 +785,7 @@ class RowPackedSaturationEngine:
             if not gating:
                 return compute(operand)
             return lax.cond(
-                dirty[next(flag)],
+                gate_flags[next(flag)],
                 compute,
                 lambda _ops: jnp.zeros((n_targets, width), jnp.uint32),
                 operand,
@@ -778,7 +846,13 @@ class RowPackedSaturationEngine:
             else lax.axis_index(axis_name) * (self.wc // self.n_shards)
         )
 
-        def contract_from(bits_state, rp_state, rows, mask_rows, mm):
+        def contract_from(bits_state, rp_state, rows, mask_rows, mm, f_dirty):
+            """``f_dirty``: scalar — did any bit-table SOURCE row of this
+            chunk change last step?  An L-iteration whose R slice is also
+            clean (``dirty_l[i]``) re-derives nothing (OR-monotone), so
+            its ``w`` operand is zeroed and the kernel's per-tile skip
+            flags drop the MXU work — the reference's two-sided
+            semi-naive join in tensor form."""
             rk = len(rows)
             subt = bits_state[jnp.asarray(rows)].T        # [W, rk], hoisted
 
@@ -793,8 +867,13 @@ class RowPackedSaturationEngine:
                         ),
                         axis_name,
                     ).astype(dt)                          # [lc, rk]
+                live = (dirty_l[i] | f_dirty).astype(dt)
                 # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
-                w = jnp.take(mask_rows, lr2d[i], axis=1).astype(dt) * f.T
+                w = (
+                    jnp.take(mask_rows, lr2d[i], axis=1).astype(dt)
+                    * f.T
+                    * live
+                )
                 b = lax.dynamic_slice(rp_state, (i * lc, 0), (lc, wlw))
                 return acc | mm(w, b)
 
@@ -805,11 +884,22 @@ class RowPackedSaturationEngine:
             )
 
         if self._p4 is not None:
-            for (raw, inv, plan), mm in zip(self._cr4_chunks, self._cr4_mm):
+            for k, ((raw, inv, plan), mm) in enumerate(
+                zip(self._cr4_chunks, self._cr4_mm)
+            ):
+                a4rows = self._a4rows[k]
 
-                def red4(ops, raw=raw, inv=inv, plan=plan, mm=mm):
+                def red4(ops, raw=raw, inv=inv, plan=plan, mm=mm,
+                         a4rows=a4rows):
                     s, r = ops
-                    out = contract_from(s, r, self._a4[raw], m4[raw], mm)
+                    f_dirty = (
+                        jnp.any(s_changed[jnp.asarray(a4rows)])
+                        if len(a4rows)
+                        else jnp.asarray(False)
+                    )
+                    out = contract_from(
+                        s, r, self._a4[raw], m4[raw], mm, f_dirty
+                    )
                     return plan.reduce(out[inv])
 
                 red = gated_rows(plan.n_targets, (sp, rp), red4)
@@ -818,10 +908,20 @@ class RowPackedSaturationEngine:
                 ch |= jnp.any(cv)
         # CR6: role chains
         if self._p6 is not None:
-            for (raw, inv, plan), mm in zip(self._cr6_chunks, self._cr6_mm):
+            for k, ((raw, inv, plan), mm) in enumerate(
+                zip(self._cr6_chunks, self._cr6_mm)
+            ):
+                l2c = self._l2chunks6[k]
 
-                def red6(r, raw=raw, inv=inv, plan=plan, mm=mm):
-                    out = contract_from(r, r, self._l26[raw], m6[raw], mm)
+                def red6(r, raw=raw, inv=inv, plan=plan, mm=mm, l2c=l2c):
+                    f_dirty = (
+                        jnp.any(dirty_l[jnp.asarray(l2c)])
+                        if len(l2c)
+                        else jnp.asarray(False)
+                    )
+                    out = contract_from(
+                        r, r, self._l26[raw], m6[raw], mm, f_dirty
+                    )
                     return plan.reduce(out[inv])
 
                 red = gated_rows(plan.n_targets, rp, red6)
@@ -849,9 +949,16 @@ class RowPackedSaturationEngine:
             cv = jnp.any(merged5 != old5)[None]
             s_vecs.append(cv)
             ch |= jnp.any(cv)
-        if gating:
-            dirty = self._next_dirty(s_vecs, r_vecs, axis_name)
-        return sp, rp, ch, dirty
+        gate_next = (
+            self._next_dirty(s_vecs, r_vecs, axis_name)
+            if gating
+            else gate_flags
+        )
+        dirty_next = (
+            gate_next,
+            *self._next_frontier(s_vecs, r_vecs, axis_name),
+        )
+        return sp, rp, ch, dirty_next
 
     def step(self, sp, rp):
         """One superstep.  On a mesh engine the matmul plans are sized to
@@ -910,7 +1017,6 @@ class RowPackedSaturationEngine:
                 changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
             return (sp, rp, it + unroll, changed, dirty)
 
-        init_bits = self._live_bits(sp0, rp0, axis_name)
         sp, rp, it, changed, _d = lax.while_loop(
             cond,
             body,
@@ -922,7 +1028,7 @@ class RowPackedSaturationEngine:
                 self.initial_dirty(),
             ),
         )
-        return sp, rp, it, changed, self._live_bits(sp, rp, axis_name), init_bits
+        return sp, rp, it, changed, self._live_bits(sp, rp, axis_name)
 
     def _sharded_run(self, max_iters: int):
         """Build (and cache per iteration budget) the jitted shard_map of
@@ -931,19 +1037,18 @@ class RowPackedSaturationEngine:
         axis = self.word_axis
 
         def run(sp0, rp0, masks):
-            sp, rp, it, changed, bits, init_bits = self._run(
+            sp, rp, it, changed, bits = self._run(
                 sp0, rp0, masks, max_iters, axis
             )
             # scalars leave as one lane per shard (replicated by
             # construction); bits leave as per-shard partial sums
-            return sp, rp, it[None], changed[None], bits, init_bits
+            return sp, rp, it[None], changed[None], bits
 
         return self._shard_jit(
             run,
             out_specs=(
                 P(None, axis),
                 P(None, axis),
-                P(axis),
                 P(axis),
                 P(axis),
                 P(axis),
@@ -1058,14 +1163,24 @@ class RowPackedSaturationEngine:
         allow_incomplete: bool = False,
     ) -> SaturationResult:
         budget = _pad_up(max_iters, self.unroll)
+        # the init count never comes from inside the donated run program
+        # (see engine.fresh_init_total): fresh runs use the analytic
+        # count, resumes pay one eager live-bits round trip
         if initial is None:
             sp0, rp0 = self.initial_state()
+            init_total = fresh_init_total(self.idx)
         else:
             sp0, rp0 = self.embed_state(*initial)
+            if self._live_bits_jit is None:
+                self._live_bits_jit = jax.jit(self._live_bits)
+            init_total = _host_bit_total(
+                fetch_global(self._live_bits_jit(sp0, rp0))
+            )
         if self.mesh is None:
             out = self._run_jit(sp0, rp0, self._masks, budget)
         else:
             out = self._run_jit(budget)(sp0, rp0, self._masks)
         return finish_device_run(
-            out, self.idx, budget, allow_incomplete, transposed=True
+            out, self.idx, budget, allow_incomplete, transposed=True,
+            init_total=init_total,
         )

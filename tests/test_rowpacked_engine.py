@@ -418,3 +418,39 @@ def test_gated_and_ungated_postures_agree():
     ).saturate()
     assert gated.derivations == ungated.derivations
     assert gated.converged and ungated.converged
+
+
+def test_fresh_init_total_matches_live_bits():
+    """The derivation metric subtracts an ANALYTIC init count (the init
+    count must never be computed inside the donated run program: under
+    memory pressure the tunnel XLA aliased that early buffer onto the
+    in-place loop state and reported zero derivations at 96k).  Guard
+    the analytic shortcut against every engine's live-bit accounting."""
+    import jax
+    import numpy as np
+
+    from distel_tpu.core.engine import (
+        SaturationEngine,
+        _host_bit_total,
+        fresh_init_total,
+    )
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.packed_engine import PackedSaturationEngine
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+    from distel_tpu.owl import parser
+
+    idx = index_ontology(
+        normalize(parser.parse(snomed_shaped_ontology(n_classes=500)))
+    )
+    expect = fresh_init_total(idx)
+    assert expect == 2 * idx.n_concepts - 1
+    for eng in (
+        SaturationEngine(idx),
+        PackedSaturationEngine(idx),
+        RowPackedSaturationEngine(idx),
+    ):
+        state = eng.initial_state()
+        got = _host_bit_total(np.asarray(jax.jit(eng._live_bits)(*state)))
+        assert got == expect, (type(eng).__name__, got, expect)
