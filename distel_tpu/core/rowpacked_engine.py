@@ -84,6 +84,33 @@ from distel_tpu.ops.bitpack import (
 )
 
 
+def _pos_maps(writers, n_rows):
+    """Layered row → concat-position maps; position ``sentinel`` indexes
+    a trailing always-False slot.  Rows written by k writers occupy k
+    layers (k ≤ number of rules writing that state matrix).  Turns
+    per-plan change vectors into a global changed-row mask with gathers
+    only — a scatter would serialize per index on TPU."""
+    offs = np.cumsum([0] + [len(t) for t in writers])
+    sentinel = int(offs[-1])  # trailing always-False concat slot
+    if not writers or n_rows == 0:
+        return []
+    mult = np.zeros(n_rows, np.int64)
+    for t in writers:
+        mult[t] += 1
+    n_layers = int(mult.max()) if len(mult) else 0
+    layers = [np.full(n_rows, sentinel, np.int64) for _ in range(n_layers)]
+    level = np.zeros(n_rows, np.int64)
+    for w, t in enumerate(writers):
+        pos = offs[w] + np.arange(len(t))
+        lv = level[t]
+        for li in range(n_layers):
+            sel = lv == li
+            if sel.any():
+                layers[li][t[sel]] = pos[sel]
+        level[t] += 1
+    return layers
+
+
 class RowPackedSaturationEngine:
     """Compiles an indexed ontology into a jitted fixed point over
     transposed row-packed state.  API mirrors ``SaturationEngine``:
@@ -350,22 +377,22 @@ class RowPackedSaturationEngine:
         # this step's write change-vectors, and the fixed point exits
         # only after a full no-change step, so convergence detection is
         # unaffected.
-        def _concat_or_empty(parts, dtype=np.int64):
-            parts = [np.asarray(p, dtype) for p in parts if len(p)]
-            return (
-                np.concatenate(parts) if parts else np.zeros(0, dtype)
-            )
-
-        self._s_fold_targets = _concat_or_empty(
+        # writer target lists in the EXACT order _step appends change
+        # vectors (CR1, CR2, CR4, CR5 / CR3, CR6); turned into layered
+        # row → concat-position gather maps (_pos_maps — a scatter would
+        # serialize per index on TPU) shared by the rule gate and the
+        # L-frontier fold
+        s_writers = (
             [piece.targets for _, piece in self._cr1_chunks]
             + [piece.targets for _, piece in self._cr2_chunks]
             + [piece.targets for _, _, piece in self._cr4_chunks]
-            + ([np.full(1, BOTTOM_ID)] if self._bottom else [])
+            + ([np.asarray([BOTTOM_ID])] if self._bottom else [])
         )
-        self._r_fold_chunks = _concat_or_empty(
-            [piece.targets for _, piece in self._cr3_chunks]
-            + [piece.targets for _, _, piece in self._cr6_chunks]
-        ) // self.lc
+        r_writers = [piece.targets for _, piece in self._cr3_chunks] + [
+            piece.targets for _, _, piece in self._cr6_chunks
+        ]
+        self._s_layers = _pos_maps(s_writers, self.nc)
+        self._r_layers = _pos_maps(r_writers, self.nl)
         self._l2chunks6 = [
             np.unique(self._l26[raw] // self.lc)
             for raw, _, _ in self._cr6_chunks
@@ -521,8 +548,8 @@ class RowPackedSaturationEngine:
     def _shard_jit(self, fn, out_specs, donate=(), with_dirty=False):
         """Shared shard_map+jit scaffolding for every mesh entry point
         (fixed point, public step, observed round): state sharded on the
-        packed word axis, masks replicated; ``with_dirty`` adds a
-        replicated frontier-flag vector between state and masks."""
+        packed word axis, masks replicated; ``with_dirty`` adds the
+        replicated 3-tuple frontier carry between state and masks."""
         P = jax.sharding.PartitionSpec
         state = P(None, self.word_axis)
         masks = (P(None, None), P(None, None))
@@ -575,63 +602,30 @@ class RowPackedSaturationEngine:
         CR4/CR6 contract over the whole R matrix, so any R change
         re-dirties them.  Flag order == chunk execution order in
         :meth:`_step`."""
-        s_writers, r_writers, readers = [], [], []
+        readers = []
         for sl, plan in self._cr1_chunks:
-            s_writers.append(plan.targets)
             readers.append(("S", np.unique(self._src1[sl])))
         for sl, plan in self._cr2_chunks:
-            s_writers.append(plan.targets)
             readers.append(
                 ("S", np.unique(np.r_[self._src2a[sl], self._src2b[sl]]))
             )
         for sl, plan in self._cr3_chunks:
-            r_writers.append(plan.targets)
             readers.append(("S", np.unique(self._src3[sl])))
         for raw, _inv, plan in self._cr4_chunks:
-            s_writers.append(plan.targets)
             readers.append(("SR", np.unique(self._a4[raw])))
         for raw, _inv, plan in self._cr6_chunks:
-            r_writers.append(plan.targets)
             readers.append(("RR", None))
         if self._bottom:
-            s_writers.append(np.asarray([BOTTOM_ID]))
             readers.append(("CR5", None))
 
-        def pos_maps(writers, n_rows):
-            """Layered row → concat-position maps; position ``sentinel``
-            indexes a trailing always-False slot.  Rows written by k
-            writers occupy k layers (k ≤ number of S-writing rules)."""
-            offs = np.cumsum([0] + [len(t) for t in writers])
-            sentinel = int(offs[-1])  # trailing always-False concat slot
-            if not writers or n_rows == 0:
-                return []
-            mult = np.zeros(n_rows, np.int64)
-            for t in writers:
-                mult[t] += 1
-            n_layers = int(mult.max()) if len(mult) else 0
-            layers = [
-                np.full(n_rows, sentinel, np.int64) for _ in range(n_layers)
-            ]
-            level = np.zeros(n_rows, np.int64)
-            for w, t in enumerate(writers):
-                pos = offs[w] + np.arange(len(t))
-                lv = level[t]
-                for li in range(n_layers):
-                    sel = lv == li
-                    if sel.any():
-                        layers[li][t[sel]] = pos[sel]
-                level[t] += 1
-            return layers
-
-        # R-side masks are unnecessary: every R reader (CR4/CR6 contract
-        # the whole matrix, CR5 reduces it) re-dirties on ANY R change,
-        # so the R writers only feed the concatenated any() below
-        s_layers = pos_maps(s_writers, self.nc)
+        # R-side masks are unnecessary for the GATE: every R reader
+        # (CR4/CR6 contract the whole matrix, CR5 reduces it) re-dirties
+        # on ANY R change.  The layered maps themselves are built once in
+        # __init__ (_pos_maps) and shared with the L-frontier fold.
         if not readers:
             return None
         return {
             "readers": readers,
-            "s_layers": s_layers,
             "n_flags": len(readers),
         }
 
@@ -683,66 +677,54 @@ class RowPackedSaturationEngine:
             rw += (self.nl + 2) * w4
         return {"hbm_bytes": rw, "mm_dense_equiv_macs": macs}
 
-    def _next_dirty(self, s_vecs, r_vecs, axis_name):
-        """End-of-step flag computation from the writers' change
-        vectors; one tiny psum makes the flags globally uniform under
-        sharding (the cond predicates must agree across shards)."""
+    def _next_dirty(self, mask_s, any_r, axis_name):
+        """End-of-step rule-gate flags from the shared changed-S-row
+        mask and the any-R-change scalar; one tiny psum makes the flags
+        globally uniform under sharding (the cond predicates must agree
+        across shards)."""
         g = self._gate
-        cs = jnp.concatenate(
-            [v.astype(bool) for v in s_vecs] + [jnp.zeros(1, bool)]
-        )
-        cr = jnp.concatenate(
-            [v.astype(bool) for v in r_vecs] + [jnp.zeros(1, bool)]
-        )
-        mask_s = None
-        for pm in g["s_layers"]:
-            got = cs[jnp.asarray(pm)]
-            mask_s = got if mask_s is None else (mask_s | got)
-        any_r = jnp.any(cr)
         flags = []
         for kind, rows in g["readers"]:
             if kind == "S":
                 d = (
                     jnp.any(mask_s[jnp.asarray(rows)])
-                    if mask_s is not None and rows.size
+                    if rows.size
                     else jnp.asarray(False)
                 )
             elif kind == "SR":
                 d = any_r
-                if mask_s is not None and rows.size:
+                if rows.size:
                     d = d | jnp.any(mask_s[jnp.asarray(rows)])
             elif kind == "RR":
                 d = any_r
             else:  # CR5
-                d = any_r
-                if mask_s is not None:
-                    d = d | mask_s[BOTTOM_ID]
+                d = any_r | mask_s[BOTTOM_ID]
             flags.append(d)
         dirty = jnp.stack(flags)
         if axis_name is not None:
             dirty = lax.psum(dirty.astype(jnp.int32), axis_name) > 0
         return dirty
 
-    def _next_frontier(self, s_vecs, r_vecs, axis_name):
-        """Fold this step's write change-vectors into the next step's
-        L-frontier: (per-L-chunk R dirty flags, changed-S-row mask).
-        Cheap static scatters — the vectors are already aligned with the
-        plans' target rows in rule order; a psum keeps the flags uniform
-        across shards (cv is computed on each shard's word slice)."""
-        s_changed = jnp.zeros(self.nc, bool)
-        if len(self._s_fold_targets) and s_vecs:
-            cv = jnp.concatenate([v.astype(bool) for v in s_vecs])
-            s_changed = s_changed.at[
-                jnp.asarray(self._s_fold_targets)
-            ].max(cv)
-        dirty_l = jnp.zeros(max(self.n_lchunks, 1), bool)
-        if len(self._r_fold_chunks) and r_vecs:
-            cv = jnp.concatenate([v.astype(bool) for v in r_vecs])
-            dirty_l = dirty_l.at[jnp.asarray(self._r_fold_chunks)].max(cv)
-        if axis_name is not None:
-            dirty_l = lax.psum(dirty_l.astype(jnp.int32), axis_name) > 0
-            s_changed = lax.psum(s_changed.astype(jnp.int32), axis_name) > 0
-        return dirty_l, s_changed
+    def _next_frontier(self, s_vecs, r_vecs):
+        """Fold this step's write change-vectors into
+        ``(changed-S-row mask [nc], any_r, per-L-chunk R dirty flags)``
+        via the layered permutation gathers of ``_pos_maps`` (a scatter
+        would serialize per index on TPU).  The caller psums the parts
+        it carries across shards."""
+        cs = jnp.concatenate(
+            [v.astype(bool) for v in s_vecs] + [jnp.zeros(1, bool)]
+        )
+        cr = jnp.concatenate(
+            [v.astype(bool) for v in r_vecs] + [jnp.zeros(1, bool)]
+        )
+        mask_s = jnp.zeros(self.nc, bool)
+        for pm in self._s_layers:
+            mask_s = mask_s | cs[jnp.asarray(pm)]
+        mask_r = jnp.zeros(self.nl, bool)
+        for pm in self._r_layers:
+            mask_r = mask_r | cr[jnp.asarray(pm)]
+        dirty_l = mask_r.reshape(self.n_lchunks, self.lc).any(axis=1)
+        return mask_s, jnp.any(cr), dirty_l
 
     def _step(
         self,
@@ -752,10 +734,14 @@ class RowPackedSaturationEngine:
         axis_name: Optional[str] = None,
         dirty: Optional[jax.Array] = None,
     ):
-        """One superstep → ``(sp, rp, changed, dirty_next)`` —
-        ``dirty``/``dirty_next`` are the frontier flags (see
-        :meth:`_build_gate`; passed through untouched, possibly ``None``,
-        when gating is off).  ``changed`` is tracked at
+        """One superstep → ``(sp, rp, changed, dirty_next)``.
+        ``dirty`` is the 3-tuple frontier carry ``(rule-chunk gate
+        flags, per-L-chunk R dirty flags, changed-S-row mask)`` — see
+        :meth:`initial_dirty`; ``None`` means all-dirty (the stateless
+        public ``step()``).  The gate flags are consulted only when
+        chunk gating is on; the L-frontier parts always gate the
+        CR4/CR6 contractions and are always refolded at the end of the
+        step.  ``changed`` is tracked at
         each rule's write (on the touched rows only) rather than by a
         whole-array post-comparison, so the pre-step state is dead as
         soon as the last rule reads it — without this the fixed-point
@@ -949,16 +935,18 @@ class RowPackedSaturationEngine:
             cv = jnp.any(merged5 != old5)[None]
             s_vecs.append(cv)
             ch |= jnp.any(cv)
+        mask_s, any_r, dirty_l_next = self._next_frontier(s_vecs, r_vecs)
         gate_next = (
-            self._next_dirty(s_vecs, r_vecs, axis_name)
+            self._next_dirty(mask_s, any_r, axis_name)
             if gating
             else gate_flags
         )
-        dirty_next = (
-            gate_next,
-            *self._next_frontier(s_vecs, r_vecs, axis_name),
-        )
-        return sp, rp, ch, dirty_next
+        if axis_name is not None:
+            dirty_l_next = (
+                lax.psum(dirty_l_next.astype(jnp.int32), axis_name) > 0
+            )
+            mask_s = lax.psum(mask_s.astype(jnp.int32), axis_name) > 0
+        return sp, rp, ch, (gate_next, dirty_l_next, mask_s)
 
     def step(self, sp, rp):
         """One superstep.  On a mesh engine the matmul plans are sized to

@@ -40,6 +40,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -238,16 +239,20 @@ def _packed_cols_kernel(a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int):
 
 
 def _packed_cols_sparse_kernel(
-    flags_ref, a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int
+    flags_ref, plk_ref, a_ref, b_ref, o_ref, acc_ref, *, dtype, tw: int
 ):
     """Tile-skipping variant of :func:`_packed_cols_kernel`.
     ``flags_ref`` (scalar-prefetch, [GM, GK] int32) marks which A tiles
     contain any nonzero: the unpack + MXU dot are skipped for all-zero A
-    tiles.  The per-step operand A = closure-mask ∧ bit-table is ~99.9%
-    sparse at saturation scale (measured 0.1% dense *at the fixed
-    point*, emptier in every earlier iteration), so most of the grid
-    skips — the matmuls are compute-bound, and the skipped dot is the
-    cost."""
+    tiles.  ``plk_ref`` ([GM, GK] int32) holds, per (i, k), the last
+    live k' ≤ k: the A/B BlockSpec index maps route dead steps back to
+    the block already resident in VMEM, so the pipeline issues **no DMA
+    for skipped tiles** — without the redirect a skipped tile still pays
+    its HBM→VMEM copy, and at the measured ~93% dead-tile fraction of
+    the role-block-diagonal CR6 operand the copies, not the MXU, bound
+    the kernel.  The per-step operand A = closure-mask ∧ bit-table is
+    ~99.9% element-sparse at saturation scale (emptier in every earlier
+    iteration)."""
     _packed_cols_prologue(acc_ref)
 
     @pl.when(flags_ref[pl.program_id(0), pl.program_id(2)] != 0)
@@ -353,42 +358,52 @@ class PackedColsMatmulPlan:
             )(a, b)
             return out[: self.m, : self.w]
         # per-A-tile any-nonzero flags, computed by XLA in one cheap pass;
-        # index maps gain a trailing scalar-prefetch ref argument
-        flags = (
+        # index maps gain trailing scalar-prefetch ref arguments
+        live = (
             (a != 0)
             .reshape(gm, self.tm, gk, self.tl)
             .any(axis=(1, 3))
-            .astype(jnp.int32)
         )
+        flags = live.astype(jnp.int32)
+        # last live k' <= k per row block (leading dead ks clamp to 0):
+        # dead grid steps re-"fetch" the block already in VMEM, which
+        # the pipeline recognizes as the same index and skips the DMA
+        plk = jnp.maximum(
+            lax.cummax(
+                jnp.where(live, jnp.arange(gk, dtype=jnp.int32)[None, :], -1),
+                axis=1,
+            ),
+            0,
+        ).astype(jnp.int32)
         out = pl.pallas_call(
             functools.partial(
                 _packed_cols_sparse_kernel, dtype=self.dtype, tw=self.tw
             ),
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1,
+                num_scalar_prefetch=2,
                 grid=grid,
                 in_specs=[
                     pl.BlockSpec(
                         a_spec[0],
-                        lambda i, j, k, f: (i, k),
+                        lambda i, j, k, f, p: (i, p[i, k]),
                         memory_space=pltpu.VMEM,
                     ),
                     pl.BlockSpec(
                         b_spec[0],
-                        lambda i, j, k, f: (k, j),
+                        lambda i, j, k, f, p: (p[i, k], j),
                         memory_space=pltpu.VMEM,
                     ),
                 ],
                 out_specs=pl.BlockSpec(
                     o_spec[0],
-                    lambda i, j, k, f: (i, j),
+                    lambda i, j, k, f, p: (i, j),
                     memory_space=pltpu.VMEM,
                 ),
                 scratch_shapes=scratch,
             ),
             out_shape=out_shape,
             interpret=self.interpret,
-        )(flags, a, b)
+        )(flags, plk, a, b)
         return out[: self.m, : self.w]
 
     def _xla(self, a: jax.Array, b_packed: jax.Array) -> jax.Array:
