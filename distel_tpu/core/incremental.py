@@ -15,10 +15,17 @@ tensor-shaped analog of semi-naive delta evaluation.
 
 Known trade-off: each increment re-traces the saturation program,
 because the rule index tables are baked into the jaxpr as constants and
-any new axiom changes them (~a few seconds per increment; the
-persistent compile cache only helps identical corpora).  Making the
-tables traced arguments padded to stable buckets would amortize this —
-deferred until streaming latency matters more than code simplicity.
+any new axiom changes them (measured: ~13 s per delta at 48k classes on
+a v5e, all of it engine build + retrace + compile — the closure itself
+stays device-resident between increments, and with the L-frontier the
+re-saturation converges in a handful of cheap steps).  The designed fix
+is an alternating delta engine — reuse the base corpus's compiled
+program (its factored masks are already traced arguments, so role-box
+growth rebinds without recompiling) and compile only a small program
+for the delta axioms plus the (old-axioms x new-links) cross terms, the
+reference's two-sided increment join — deferred: the cross-term
+coverage (CR4/CR6 over new links, CR5 over the grown link table) has
+enough soundness corners that it needs its own verification round.
 """
 
 from __future__ import annotations
@@ -58,7 +65,9 @@ class IncrementalClassifier:
         self.indexer = Indexer()
         self.accumulated = NormalizedOntology()
         self._normalizer_cache: dict = {}
-        self._state: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        #: packed closure between increments — device jax.Arrays on the
+        #: transposed path (never fetched to host), numpy otherwise
+        self._state: Optional[Tuple] = None
         self.increment = 0  # the reference's CURRENT_INCREMENT counter
         self.history: List[dict] = []
         self.last_result: Optional[SaturationResult] = None
@@ -81,9 +90,10 @@ class IncrementalClassifier:
             initial=self._state,
         )
         if result.transposed:
-            # keep the closure packed (32x smaller than the unpacked
-            # bool square; embed_state re-embeds packed rows verbatim)
-            result._fetch()
+            # keep the closure packed AND device-resident: the next
+            # increment's embed runs on device, so the closure never
+            # crosses the tunnel between increments (a host round trip
+            # costs minutes at 64k scale)
             self._state = (result.packed_s, result.packed_r)
         else:
             self._state = (result.s, result.r)

@@ -415,6 +415,7 @@ class RowPackedSaturationEngine:
         self._initial_jit = None
         self._observe_jit = None
         self._live_bits_jit = None
+        self._embed_dev_jit = None
         # donate the state buffers: every saturate() builds fresh arrays
         # (initial_state / embed_state), and without donation XLA keeps a
         # full input copy alive across the loop — 2x state memory
@@ -467,6 +468,13 @@ class RowPackedSaturationEngine:
         an old run's padded x-columns evolve exactly as fresh concepts
         with S(x)={x,⊤} and no axioms — i.e. the correct warm start for
         ids later assigned to new concepts."""
+        if isinstance(s_old, jax.Array) and s_old.dtype == jnp.uint32:
+            # device-resident wire state (the incremental path): embed on
+            # device — at 64k scale a host round trip of the closure costs
+            # minutes over the remote-attach tunnel
+            return self._embed_packed_device(
+                s_old, r_old, allow_shrink=allow_shrink
+            )
         if np.asarray(s_old).dtype == np.uint32:
             return self._embed_packed(
                 np.asarray(s_old),
@@ -510,6 +518,48 @@ class RowPackedSaturationEngine:
                 jax.device_put(rp, self._state_sharding),
             )
         return jnp.asarray(sp), jnp.asarray(rp)
+
+    def _embed_packed_device(
+        self,
+        sp_old: jax.Array,
+        rp_old: jax.Array,
+        *,
+        allow_shrink: bool = False,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Device-side :meth:`_embed_packed`: pad the wire rows into the
+        (possibly grown) arrays and OR in the fresh-concept init, without
+        the closure ever visiting the host.  Always emits FRESH buffers
+        (one fused HBM pass, ~ms) — ``saturate`` donates its initial
+        state into the run, and passing a caller's buffers through would
+        silently invalidate the result they came from."""
+        check_embed_fits(
+            allow_shrink,
+            subsumer_rows=(sp_old.shape[0], self.nc),
+            x_words=(sp_old.shape[1], self.wc),
+            link_rows=(rp_old.shape[0], self.nl),
+        )
+        if self._embed_dev_jit is None:
+
+            def embed(sp_old, rp_old):
+                sp, rp = self._initial_arrays()
+                na = min(sp_old.shape[0], self.nc)
+                nw = min(sp_old.shape[1], self.wc)
+                sp = sp.at[:na, :nw].set(
+                    sp[:na, :nw] | sp_old[:na, :nw]
+                )
+                nlr = min(rp_old.shape[0], self.nl)
+                rp = rp.at[:nlr, :nw].set(rp_old[:nlr, :nw])
+                return sp, rp
+
+            out_shardings = (
+                None
+                if self._state_sharding is None
+                else (self._state_sharding, self._state_sharding)
+            )
+            self._embed_dev_jit = jax.jit(
+                embed, out_shardings=out_shardings
+            )
+        return self._embed_dev_jit(sp_old, rp_old)
 
     def _embed_packed(
         self,

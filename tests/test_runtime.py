@@ -650,3 +650,47 @@ def test_taxonomy_adaptive_parent_cap():
     # the public API takes the device path without raising
     tax = extract_taxonomy(result, method="device")
     assert len(tax.parents["Hub"]) == wide
+
+
+def test_incremental_state_stays_device_resident():
+    """Between increments the packed closure must remain a device array:
+    the r1 behavior fetched it to the host and re-uploaded on the next
+    add (minutes of tunnel time at 64k scale)."""
+    import jax
+
+    inc = IncrementalClassifier()
+    r1 = inc.add_text("SubClassOf(A B)\nSubClassOf(A ObjectSomeValuesFrom(r C))")
+    assert isinstance(inc._state[0], jax.Array)
+    r2 = inc.add_text("SubClassOf(B D)\nSubClassOf(ObjectSomeValuesFrom(r C) E)")
+    assert isinstance(inc._state[0], jax.Array)
+    assert r2.derivations > 0
+    # and the merged closure still matches a cold batch run
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+
+    batch = RowPackedSaturationEngine(
+        index_ontology(normalize(parser.parse(
+            "SubClassOf(A B)\nSubClassOf(A ObjectSomeValuesFrom(r C))\n"
+            "SubClassOf(B D)\nSubClassOf(ObjectSomeValuesFrom(r C) E)"
+        )))
+    ).saturate()
+    n = batch.idx.n_concepts
+    sub_inc = {
+        batch.idx.concept_names[x]: {
+            r2.idx.concept_names[i]
+            for i in r2.subsumers(r2.idx.concept_ids[batch.idx.concept_names[x]])
+            if i < r2.idx.n_concepts
+        }
+        for x in range(n)
+    }
+    sub_batch = {
+        batch.idx.concept_names[x]: {
+            batch.idx.concept_names[i]
+            for i in batch.subsumers(x)
+            if i < n
+        }
+        for x in range(n)
+    }
+    assert sub_inc == sub_batch
