@@ -75,6 +75,10 @@ class IncrementalClassifier:
     def add_text(self, text: str) -> SaturationResult:
         return self.add_ontology(owl_loader.load(text))
 
+    def _pop_state(self):
+        state, self._state = self._state, None
+        return state
+
     def add_ontology(self, onto) -> SaturationResult:
         normalizer = Normalizer(cache=self._normalizer_cache)
         batch = normalizer.normalize(onto)
@@ -85,9 +89,15 @@ class IncrementalClassifier:
         from distel_tpu.runtime.classifier import make_engine
 
         engine = make_engine(self.config, idx, mesh=self._mesh)
+        # hand the old closure over without keeping a reference in this
+        # frame: the embed copies it into the grown arrays, and holding
+        # the old device buffers through the run would add a full extra
+        # state to peak HBM — the difference between the incremental and
+        # batch ceilings
+        self.last_result = None
         result = engine.saturate(
             self.config.max_iterations,
-            initial=self._state,
+            initial=self._pop_state(),
         )
         if result.transposed:
             # keep the closure packed AND device-resident: the next

@@ -127,10 +127,13 @@ class RowPackedSaturationEngine:
         *,
         pad_multiple: int = 128,
         matmul_dtype=None,
-        # 2 steps per vote measured best on a v5e: unroll=1 pays loop
-        # overhead per step, unroll=4 doubles compile time and overshoots
-        # the fixed point by more wasted steps
-        unroll: int = 2,
+        # None = auto: 2 steps per vote (measured best on a v5e —
+        # unroll=1 pays loop overhead per step, unroll=4 doubles compile
+        # time and overshoots the fixed point), dropping to 1 at
+        # very-large state where the second unrolled body's live chunk
+        # buffers are the difference between fitting one chip and OOM
+        # (measured at 112k many-role classes: 15.96 GB vs 12.35 GB)
+        unroll: Optional[int] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
         word_axis: str = "c",
         temp_budget_bytes: Optional[int] = None,
@@ -160,7 +163,6 @@ class RowPackedSaturationEngine:
                 raise ValueError(f"unknown rules: {sorted(unknown)}")
         self._rules = rules
         self.idx = idx
-        self.unroll = max(int(unroll), 1)
         self.mesh = mesh
         self.word_axis = word_axis
         self.n_shards = int(mesh.shape[word_axis]) if mesh is not None else 1
@@ -192,6 +194,12 @@ class RowPackedSaturationEngine:
         large = state_bytes > (
             (3 << 29) if mesh is not None else (5 << 29)
         )
+        if unroll is None:
+            # second tier: past ~4.8 GB of per-shard state the second
+            # unrolled body's live chunk buffers alone break one chip
+            # (112k many-role: 12.35 GB at unroll=1 vs 15.96 GB at 2)
+            unroll = 1 if state_bytes > (9 << 29) else 2
+        self.unroll = max(int(unroll), 1)
         if temp_budget_bytes is None:
             temp_budget_bytes = (1 << 28) if large else (1 << 29)
         if gate_chunks is None and large:
@@ -1209,6 +1217,7 @@ class RowPackedSaturationEngine:
             init_total = fresh_init_total(self.idx)
         else:
             sp0, rp0 = self.embed_state(*initial)
+            initial = None  # the embed copied it: free the old closure
             if self._live_bits_jit is None:
                 self._live_bits_jit = jax.jit(self._live_bits)
             init_total = _host_bit_total(

@@ -659,7 +659,7 @@ def test_incremental_state_stays_device_resident():
     import jax
 
     inc = IncrementalClassifier()
-    r1 = inc.add_text("SubClassOf(A B)\nSubClassOf(A ObjectSomeValuesFrom(r C))")
+    inc.add_text("SubClassOf(A B)\nSubClassOf(A ObjectSomeValuesFrom(r C))")
     assert isinstance(inc._state[0], jax.Array)
     r2 = inc.add_text("SubClassOf(B D)\nSubClassOf(ObjectSomeValuesFrom(r C) E)")
     assert isinstance(inc._state[0], jax.Array)
