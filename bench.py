@@ -148,6 +148,22 @@ def main() -> None:
             )
             extra["baseline_converged_n_concepts"] = cidx.n_concepts
 
+        # ---- incremental delta (the reference's traffic-data
+        # scenario, scripts/traffic-data-load-classify.sh): base
+        # corpus, then a small axiom batch on top of the closure ----
+        from distel_tpu.core.incremental import IncrementalClassifier
+
+        inc = IncrementalClassifier()
+        inc.add_text(snomed_shaped_ontology(n_classes=16000))
+        delta = "\n".join(
+            f"SubClassOf(BenchDelta{i} Find{i * 7})" for i in range(100)
+        )
+        t0 = time.time()
+        dres = inc.add_text(delta)
+        extra["incremental_delta_s"] = round(time.time() - t0, 2)
+        extra["incremental_delta_axioms"] = 100
+        extra["incremental_delta_new_derivations"] = dres.derivations
+
         # ---- latency-sensitivity probe: GALEN-shaped 16k ----
         gtext = synthetic_ontology(
             n_classes=16000, n_anatomy=1600, n_locations=1333,
