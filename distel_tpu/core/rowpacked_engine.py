@@ -142,6 +142,7 @@ class RowPackedSaturationEngine:
         mm_opts: Optional[dict] = None,
         l_chunk: Optional[int] = None,
         gate_chunks: Optional[bool] = None,
+        min_links_pad: int = 0,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -171,7 +172,10 @@ class RowPackedSaturationEngine:
         self.nc = _pad_up(
             _pad_up(max(idx.n_concepts, 2), pad_multiple), 32 * self.n_shards
         )
-        self.nl = max(_pad_up(idx.n_links, 32), 32)
+        # min_links_pad: a cooperating engine (the incremental delta
+        # fast path) can force this engine's link-row padding up to
+        # another engine's, so their packed states interchange verbatim
+        self.nl = max(_pad_up(idx.n_links, 32), 32, _pad_up(min_links_pad, 32))
         self.wc = self.nc // 32
         # ---- size-adaptive memory posture (measured on a 16 GB v5e with
         # the 96k-class many-role corpus, state = S_T 2.2 GB + R_T 1.6 GB):
@@ -1207,7 +1211,13 @@ class RowPackedSaturationEngine:
         *,
         initial: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         allow_incomplete: bool = False,
+        init_total: Optional[int] = None,
     ) -> SaturationResult:
+        """``init_total``: callers that track derivation accounting
+        themselves (the incremental fast path's alternation loop, which
+        recounts under the full universe at the end) can pass a value —
+        any value — to skip the eager live-bits round trip; the result's
+        ``derivations`` is then only meaningful to that caller."""
         budget = _pad_up(max_iters, self.unroll)
         # the init count never comes from inside the donated run program
         # (see engine.fresh_init_total): fresh runs use the analytic
@@ -1218,11 +1228,12 @@ class RowPackedSaturationEngine:
         else:
             sp0, rp0 = self.embed_state(*initial)
             initial = None  # the embed copied it: free the old closure
-            if self._live_bits_jit is None:
-                self._live_bits_jit = jax.jit(self._live_bits)
-            init_total = _host_bit_total(
-                fetch_global(self._live_bits_jit(sp0, rp0))
-            )
+            if init_total is None:
+                if self._live_bits_jit is None:
+                    self._live_bits_jit = jax.jit(self._live_bits)
+                init_total = _host_bit_total(
+                    fetch_global(self._live_bits_jit(sp0, rp0))
+                )
         if self.mesh is None:
             out = self._run_jit(sp0, rp0, self._masks, budget)
         else:

@@ -694,3 +694,162 @@ def test_incremental_state_stays_device_resident():
         for x in range(n)
     }
     assert sub_inc == sub_batch
+
+
+def test_incremental_delta_fast_path_matches_batch():
+    """Class-only deltas must take the base-program-reuse fast path and
+    still produce the exact batch closure; link-creating deltas must
+    fall back to a full rebuild."""
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+    from distel_tpu.owl import parser
+
+    base = snomed_shaped_ontology(n_classes=600)
+    # class-only delta: subclassing + a conjunction + an existential over
+    # an EXISTING link, plus a disjointness (exercises delta-side CR5)
+    delta1 = (
+        "SubClassOf(Extra0 Find3)\n"
+        "SubClassOf(Extra1 ObjectIntersectionOf(Find3 Find5))\n"
+        "SubClassOf(ObjectIntersectionOf(Find3 Find5) ExtraBoth)\n"
+        "DisjointClasses(Extra2 Find3)\nSubClassOf(Extra2 Find3)\n"
+    )
+    # link-creating delta: a fresh role forces the full rebuild
+    delta2 = "SubClassOf(Extra3 ObjectSomeValuesFrom(brandNewRole Find9))\n"
+
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0  # force the fast path at test scale
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    assert base_engine is not None
+    r1 = inc.add_text(delta1)
+    assert inc._base_engine is base_engine  # fast path: no rebuild
+    assert r1.derivations > 0
+    r2 = inc.add_text(delta2)
+    assert inc._base_engine is not base_engine  # rebuilt (new link)
+
+    # the final closure must equal a cold batch run, name for name
+    batch_idx = index_ontology(normalize(parser.parse(base + delta1 + delta2)))
+    batch = RowPackedSaturationEngine(batch_idx).saturate()
+    n = batch_idx.n_concepts
+    sub_inc = {
+        batch_idx.concept_names[x]: {
+            r2.idx.concept_names[i]
+            for i in r2.subsumers(r2.idx.concept_ids[batch_idx.concept_names[x]])
+            if i < r2.idx.n_concepts
+        }
+        for x in range(n)
+    }
+    sub_batch = {
+        batch_idx.concept_names[x]: {
+            batch_idx.concept_names[i] for i in batch.subsumers(x) if i < n
+        }
+        for x in range(n)
+    }
+    assert sub_inc == sub_batch
+    # unsat introduced by the delta survived the fast path
+    assert "owl:Nothing" in sub_inc["Extra2"]
+
+
+def test_incremental_fast_path_multi_round_alternation():
+    """A delta whose consequences flow delta→base→delta (new class under
+    an old class that an old chain/existential feeds back into a new
+    conjunction) needs more than one alternation round — the termination
+    signal must be the raw change, not the base engine's masked count."""
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+
+    base = (
+        "SubClassOf(A B)\nSubClassOf(B C)\n"
+        "SubClassOf(C ObjectSomeValuesFrom(r D))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r D) E)\n"
+        "SubClassOf(E F)\n"
+    )
+    # New0 ⊑ A: base CR1 chain gives New0 ⊑ B,C, base CR3/CR4 give E,F;
+    # then the DELTA conjunction F ⊓ C ⊑ New1 fires only after the base
+    # pass — and New1 ⊑ G (delta) then base has nothing more
+    delta = (
+        "SubClassOf(New0 A)\n"
+        "SubClassOf(ObjectIntersectionOf(F C) NewBoth)\n"
+        "SubClassOf(NewBoth NewTop)\n"
+    )
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0  # force the fast path at test scale
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    r = inc.add_text(delta)
+    assert inc._base_engine is base_engine  # fast path taken
+    names = {
+        r.idx.concept_names[i]
+        for i in r.subsumers(r.idx.concept_ids["New0"])
+        if i < r.idx.n_concepts
+    }
+    assert {"A", "B", "C", "E", "F", "NewBoth", "NewTop"} <= names
+    batch = RowPackedSaturationEngine(
+        index_ontology(normalize(parser.parse(base + delta)))
+    ).saturate()
+    bn = {
+        batch.idx.concept_names[i]
+        for i in batch.subsumers(batch.idx.concept_ids["New0"])
+        if i < batch.idx.n_concepts
+    }
+    assert names == bn
+
+
+def test_incremental_fast_path_nf4_sorts_into_prefix():
+    """The indexer globally SORTS nf4, so a delta CR4 axiom can sort
+    before existing rows: a positional tail slice would hand it to
+    NEITHER the base program (compiled before it existed) nor the delta
+    program — silently incomplete closure.  The delta must be computed
+    as a set difference."""
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+
+    # zRole sorts AFTER aRole alphabetically; the indexer interns roles
+    # in appearance order, so base's nf4 rows use a LATER role id than
+    # the delta's aRole-axiom only if aRole appears first — arrange the
+    # base to mention aRole (creating its id and a link) while its nf4
+    # axiom uses zRole, so the delta's nf4 row sorts into the prefix
+    base = (
+        "SubClassOf(Seed ObjectSomeValuesFrom(zRole Mid))\n"
+        "SubClassOf(ObjectSomeValuesFrom(zRole Mid) ZTarget)\n"
+        "SubClassOf(Other ObjectSomeValuesFrom(aRole Filler))\n"
+        "SubClassOf(Filler FillerSup)\n"
+    )
+    delta = "SubClassOf(ObjectSomeValuesFrom(aRole Filler) ATarget)\n"
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    b_idx = inc._base_idx
+    r = inc.add_text(delta)
+    assert inc._base_engine is base_engine, "premise: fast path taken"
+    full_idx = r.idx
+    # premise: the new nf4 row is NOT a tail extension of the base's
+    import numpy as np
+
+    assert len(full_idx.nf4) == len(b_idx.nf4) + 1
+    assert not np.array_equal(full_idx.nf4[: len(b_idx.nf4)], b_idx.nf4), (
+        "premise: the delta nf4 row must sort into the prefix"
+    )
+    sups = {
+        full_idx.concept_names[i]
+        for i in r.subsumers(full_idx.concept_ids["Other"])
+        if i < full_idx.n_concepts
+    }
+    assert "ATarget" in sups, sups
+    # cross-check the whole closure against a cold batch run
+    batch = RowPackedSaturationEngine(
+        index_ontology(normalize(parser.parse(base + delta)))
+    ).saturate()
+    bsups = {
+        batch.idx.concept_names[i]
+        for i in batch.subsumers(batch.idx.concept_ids["Other"])
+        if i < batch.idx.n_concepts
+    }
+    assert sups == bsups
