@@ -244,12 +244,18 @@ class RowPackedSaturationEngine:
             idx.has_bottom_axioms and idx.n_links and on("CR5")
         )
 
-        # Bound per-rule temporaries by splitting each rule into chunks at
-        # segment boundaries: a fused application materializes O(K·wc)
-        # gather/scan buffers (CR1-CR3) or — on the XLA matmul fallback —
-        # an O(K·nc) i32 product (CR4/CR6); unchunked, either exceeds HBM
-        # near 100k concepts.  The Pallas kernel keeps CR4/CR6 packed end
-        # to end, so there the chunk bound is only the packed output.
+        # CR1-CR3 (and CR5) are NOT split into per-axiom chunks: their
+        # full static plans are swept over WORD BLOCKS of the state
+        # instead (see the block loop in :meth:`_step`), which bounds the
+        # per-rule temporaries to O(K·bw) while keeping the traced
+        # program size independent of the corpus — one traced block body
+        # instead of one body per chunk.  Round 2 unrolled one traced
+        # body per chunk, and XLA compile time grew super-linearly in
+        # chunk count (measured: 8/32/64 CR1 chunks at a fixed 128k
+        # corpus compile in 1.7/11.7/18.3 s; the 300k-class superstep
+        # took 74 minutes).  CR4/CR6 stay row-chunked: their contraction
+        # needs the full word axis per row chunk (the bit-table reads
+        # filler columns anywhere in the row), so they cannot word-block.
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
         self._use_pallas = use_pallas
@@ -259,9 +265,6 @@ class RowPackedSaturationEngine:
             if use_pallas
             else max(temp_budget_bytes // 2 // (self.nc * 4), 1)
         )
-        self._cr1_chunks = self._p1.split(gather_rows)
-        self._cr2_chunks = self._p2.split(gather_rows // 2)
-        self._cr3_chunks = self._p3.split(gather_rows)
 
         def mm_chunks(plan):
             """[(raw_ids, inv, piece)]: the matmul runs over the chunk's
@@ -303,6 +306,30 @@ class RowPackedSaturationEngine:
         lc = _pad_up(-(-self.nl // self.n_lchunks), 32)
         self.nl = self.n_lchunks * lc
         self.lc = lc
+
+        # ---- word-block sweep plan for CR1-CR3 + CR5: the block width
+        # bounds each rule's gather/reduce temporaries (the widest live
+        # buffer is [K, bw]); blocks tile the shard-local word axis and
+        # the LAST block overlaps its predecessor instead of padding
+        # (off = min(i*bw, wl-bw)) — re-deriving a word twice in one
+        # step is sound because every rule is an idempotent monotone OR.
+        # Overlap instead of padding keeps nc/nl independent of the
+        # block plan, which the incremental fast path's state-layout
+        # interlock (core/incremental.py) relies on.
+        wl = self.wc // self.n_shards
+        emission_max = max(
+            self._p1.k,
+            2 * self._p2.k,  # two gathers live at once
+            self._p3.k,
+            (2 * self.nl) if self._bottom else 0,  # CR5 mask + reduce
+            1,
+        )
+        bw = temp_budget_bytes // (4 * emission_max)
+        if bw >= 128:
+            bw = bw // 128 * 128  # lane-aligned slices when affordable
+        bw = max(min(bw, wl), 1)
+        self._bw = bw
+        self._n_sblocks = -(-wl // bw)
 
         # link-table arrays at the final width
         h = idx.role_closure
@@ -395,12 +422,12 @@ class RowPackedSaturationEngine:
         # serialize per index on TPU) shared by the rule gate and the
         # L-frontier fold
         s_writers = (
-            [piece.targets for _, piece in self._cr1_chunks]
-            + [piece.targets for _, piece in self._cr2_chunks]
+            ([self._p1.targets] if self._p1.k else [])
+            + ([self._p2.targets] if self._p2.k else [])
             + [piece.targets for _, _, piece in self._cr4_chunks]
             + ([np.asarray([BOTTOM_ID])] if self._bottom else [])
         )
-        r_writers = [piece.targets for _, piece in self._cr3_chunks] + [
+        r_writers = ([self._p3.targets] if self._p3.k else []) + [
             piece.targets for _, _, piece in self._cr6_chunks
         ]
         self._s_layers = _pos_maps(s_writers, self.nc)
@@ -549,6 +576,7 @@ class RowPackedSaturationEngine:
             subsumer_rows=(sp_old.shape[0], self.nc),
             x_words=(sp_old.shape[1], self.wc),
             link_rows=(rp_old.shape[0], self.nl),
+            link_x_words=(rp_old.shape[1], self.wc),
         )
         if self._embed_dev_jit is None:
 
@@ -587,6 +615,7 @@ class RowPackedSaturationEngine:
             subsumer_rows=(sp_old.shape[0], self.nc),
             x_words=(sp_old.shape[1], self.wc),
             link_rows=(rp_old.shape[0], self.nl),
+            link_x_words=(rp_old.shape[1], self.wc),
         )
         rows = np.arange(self.nc)
         sp = np.zeros((self.nc, self.wc), np.uint32)
@@ -665,19 +694,14 @@ class RowPackedSaturationEngine:
         re-dirties them.  Flag order == chunk execution order in
         :meth:`_step`."""
         readers = []
-        for sl, plan in self._cr1_chunks:
-            readers.append(("S", np.unique(self._src1[sl])))
-        for sl, plan in self._cr2_chunks:
-            readers.append(
-                ("S", np.unique(np.r_[self._src2a[sl], self._src2b[sl]]))
-            )
-        for sl, plan in self._cr3_chunks:
-            readers.append(("S", np.unique(self._src3[sl])))
         for raw, _inv, plan in self._cr4_chunks:
             readers.append(("SR", np.unique(self._a4[raw])))
         for raw, _inv, plan in self._cr6_chunks:
             readers.append(("RR", None))
         if self._bottom:
+            # CR5 keeps its gate inside the word-block sweep (always the
+            # LAST flag): its masked OR-reduce sweeps all of R_T, which
+            # unlike CR1-3's axiom-count-bound gathers scales with nl·wc
             readers.append(("CR5", None))
 
         # R-side masks are unnecessary for the GATE: every R reader
@@ -721,13 +745,14 @@ class RowPackedSaturationEngine:
         """
         w4 = 4 * self.wc  # bytes per packed row
         rw = 0
-        for plans in (self._cr1_chunks, self._cr3_chunks):
-            for sl, piece in plans:
-                rw += (sl.stop - sl.start) * w4          # gathered sources
-                rw += 2 * piece.n_targets * w4           # target RMW
-        for sl, piece in self._cr2_chunks:
-            rw += 2 * (sl.stop - sl.start) * w4
-            rw += 2 * piece.n_targets * w4
+        for p in (self._p1, self._p3):
+            rw += p.k * w4                               # gathered sources
+            rw += 2 * p.n_targets * w4                   # target RMW
+        rw += 2 * self._p2.k * w4
+        rw += 2 * self._p2.n_targets * w4
+        if self._n_sblocks > 1:
+            # block slice + write-back traffic of the word sweep
+            rw += 2 * (self.nc + self.nl) * w4
         macs = 0
         for chunks in (self._cr4_chunks, self._cr6_chunks):
             for raw, _inv, piece in chunks:
@@ -747,13 +772,7 @@ class RowPackedSaturationEngine:
         g = self._gate
         flags = []
         for kind, rows in g["readers"]:
-            if kind == "S":
-                d = (
-                    jnp.any(mask_s[jnp.asarray(rows)])
-                    if rows.size
-                    else jnp.asarray(False)
-                )
-            elif kind == "SR":
+            if kind == "SR":
                 d = any_r
                 if rows.size:
                     d = d | jnp.any(mask_s[jnp.asarray(rows)])
@@ -839,38 +858,113 @@ class RowPackedSaturationEngine:
                 operand,
             )
 
-        # CR1: a ⊑ b
-        for sl, plan in self._cr1_chunks:
-            red = gated_rows(
-                plan.n_targets,
-                sp,
-                lambda s, sl=sl, plan=plan: plan.reduce(s[self._src1[sl]]),
-            )
-            sp, cv = plan.write(sp, red, track="rows")
-            s_vecs.append(cv)
-            ch |= jnp.any(cv)
-        # CR2: a1 ⊓ a2 ⊑ b
-        for sl, plan in self._cr2_chunks:
-            red = gated_rows(
-                plan.n_targets,
-                sp,
-                lambda s, sl=sl, plan=plan: plan.reduce(
-                    s[self._src2a[sl]] & s[self._src2b[sl]]
-                ),
-            )
-            sp, cv = plan.write(sp, red, track="rows")
-            s_vecs.append(cv)
-            ch |= jnp.any(cv)
-        # CR3: a ⊑ ∃link — reads S, writes R
-        for sl, plan in self._cr3_chunks:
-            red = gated_rows(
-                plan.n_targets,
-                sp,
-                lambda s, sl=sl, plan=plan: plan.reduce(s[self._src3[sl]]),
-            )
-            rp, cv = plan.write(rp, red, track="rows")
-            r_vecs.append(cv)
-            ch |= jnp.any(cv)
+        # ---- CR1/CR2/CR3/CR5: full static plans, swept over word
+        # blocks.  Each rule is column-local (a row write's word w
+        # depends only on its sources' word w), so a [rows, bw] block is
+        # a complete sub-problem; the sweep bounds temporaries to
+        # O(K·bw) with ONE traced body regardless of corpus size —
+        # per-axiom chunking compiled one body per chunk and XLA compile
+        # time grew super-linearly in chunk count (74 min at 300k
+        # classes).  CR5's ⊥-filler mask is the one column-global input
+        # (bits at filler columns anywhere in the row), so it is
+        # computed full-width before the sweep — reading the pre-sweep
+        # S_T[⊥] only delays a consequence into the next superstep,
+        # which the no-change convergence vote never misses.
+        cv5 = None
+        if self._p1.k or self._p2.k or self._p3.k or self._bottom:
+            botf = None
+            if self._bottom:
+                bt = self._bit_table(sp, np.full(1, BOTTOM_ID), axis_name)
+                botf = bt[:, 0].astype(bool)  # [nl]
+
+            def block_rules(sb, rb):
+                cvs = []
+                if self._p1.k:  # CR1: a ⊑ b
+                    red = self._p1.reduce(sb[jnp.asarray(self._src1)])
+                    sb, cv = self._p1.write(sb, red, track="rows")
+                    cvs.append(cv)
+                if self._p2.k:  # CR2: a1 ⊓ a2 ⊑ b
+                    red = self._p2.reduce(
+                        sb[jnp.asarray(self._src2a)]
+                        & sb[jnp.asarray(self._src2b)]
+                    )
+                    sb, cv = self._p2.write(sb, red, track="rows")
+                    cvs.append(cv)
+                if self._p3.k:  # CR3: a ⊑ ∃link — reads S, writes R
+                    red = self._p3.reduce(sb[jnp.asarray(self._src3)])
+                    rb, cv = self._p3.write(rb, red, track="rows")
+                    cvs.append(cv)
+                if self._bottom:  # CR5: ⊥ back-propagation
+
+                    def red5(r):
+                        masked = jnp.where(
+                            botf[:, None], r, jnp.asarray(0, jnp.uint32)
+                        )
+                        return lax.reduce(
+                            masked, np.uint32(0), lax.bitwise_or, (0,)
+                        )
+
+                    if gating:
+                        # CR5's flag is always the LAST gate flag; only
+                        # the [bw] reduced row crosses the cond boundary
+                        red = lax.cond(
+                            gate_flags[self._gate["n_flags"] - 1],
+                            red5,
+                            lambda r: jnp.zeros((rb.shape[1],), jnp.uint32),
+                            rb,
+                        )
+                    else:
+                        red = red5(rb)
+                    old = sb[BOTTOM_ID]
+                    merged = old | red
+                    sb = sb.at[BOTTOM_ID].set(merged)
+                    cvs.append(jnp.any(merged != old)[None])
+                return sb, rb, cvs
+
+            if self._n_sblocks == 1:
+                sp, rp, cvs = block_rules(sp, rp)
+            else:
+                bw = self._bw
+                nrows_s, nrows_r = sp.shape[0], rp.shape[0]
+                zeros = []
+                if self._p1.k:
+                    zeros.append(jnp.zeros(self._p1.n_targets, bool))
+                if self._p2.k:
+                    zeros.append(jnp.zeros(self._p2.n_targets, bool))
+                if self._p3.k:
+                    zeros.append(jnp.zeros(self._p3.n_targets, bool))
+                if self._bottom:
+                    zeros.append(jnp.zeros(1, bool))
+
+                def body(bi, carry):
+                    sp, rp, cvs = carry
+                    off = jnp.minimum(bi * bw, width - bw)
+                    sb = lax.dynamic_slice(sp, (0, off), (nrows_s, bw))
+                    rb = lax.dynamic_slice(rp, (0, off), (nrows_r, bw))
+                    sb, rb, cv = block_rules(sb, rb)
+                    sp = lax.dynamic_update_slice(sp, sb, (0, off))
+                    rp = lax.dynamic_update_slice(rp, rb, (0, off))
+                    return sp, rp, [a | b for a, b in zip(cvs, cv)]
+
+                sp, rp, cvs = lax.fori_loop(
+                    0, self._n_sblocks, body, (sp, rp, zeros)
+                )
+            cvs = iter(cvs)
+            if self._p1.k:
+                cv = next(cvs)
+                s_vecs.append(cv)
+                ch |= jnp.any(cv)
+            if self._p2.k:
+                cv = next(cvs)
+                s_vecs.append(cv)
+                ch |= jnp.any(cv)
+            if self._p3.k:
+                cv = next(cvs)
+                r_vecs.append(cv)
+                ch |= jnp.any(cv)
+            if self._bottom:
+                cv5 = next(cvs)  # appended to s_vecs after CR4 (writer
+                ch |= jnp.any(cv5)  # order: CR1, CR2, CR4 chunks, CR5)
         # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
         # HBM end to end (the Pallas kernel unpacks/repacks per VMEM tile;
         # the XLA fallback materializes the wide operands instead).  The
@@ -976,27 +1070,10 @@ class RowPackedSaturationEngine:
                 rp, cv = plan.write(rp, red, track="rows")
                 r_vecs.append(cv)
                 ch |= jnp.any(cv)
-        # CR5: ⊥ back-propagation — one masked packed OR-reduce
-        if self._bottom:
-
-            def red5(ops):
-                s, r = ops
-                botf = self._bit_table(s, np.full(1, BOTTOM_ID), axis_name)
-                mask = botf[:, 0].astype(bool)              # [nl]
-                masked = jnp.where(
-                    mask[:, None], r, jnp.asarray(0, jnp.uint32)
-                )
-                return lax.reduce(
-                    masked, np.uint32(0), lax.bitwise_or, (0,)
-                )[None]
-
-            red = gated_rows(1, (sp, rp), red5)
-            old5 = sp[BOTTOM_ID]
-            merged5 = old5 | red[0]
-            sp = sp.at[BOTTOM_ID].set(merged5)
-            cv = jnp.any(merged5 != old5)[None]
-            s_vecs.append(cv)
-            ch |= jnp.any(cv)
+        # CR5 ran inside the word-block sweep; its change vector slots
+        # into writer order here (CR1, CR2, CR4 chunks, CR5)
+        if cv5 is not None:
+            s_vecs.append(cv5)
         mask_s, any_r, dirty_l_next = self._next_frontier(s_vecs, r_vecs)
         gate_next = (
             self._next_dirty(mask_s, any_r, axis_name)
