@@ -229,15 +229,15 @@ class RowPackedSaturationEngine:
         self._p3 = SegmentedRowOr(nf3[:, 1])
         self._src3 = nf3[self._p3.order, 0]
 
-        # CR4/CR6 row plans (masks and link-table arrays are built later,
-        # once the final padded link-axis width is known)
-        self._p4 = None
-        if len(idx.nf4) and idx.n_links and on("CR4"):
-            self._p4 = SegmentedRowOr(idx.nf4[:, 2])
+        # CR4/CR6 row tables (chunking, masks and link-table arrays are
+        # built later, once the final padded link-axis width is known)
+        self._has4 = bool(len(idx.nf4) and idx.n_links and on("CR4"))
+        if self._has4:
             self._a4 = idx.nf4[:, 1]
-        self._p6 = None
-        if len(idx.chain_pairs) and idx.n_links and on("CR6"):
-            self._p6 = SegmentedRowOr(idx.chain_pairs[:, 2])
+        self._has6 = bool(
+            len(idx.chain_pairs) and idx.n_links and on("CR6")
+        )
+        if self._has6:
             self._l26 = idx.chain_pairs[:, 1]
 
         self._bottom = bool(
@@ -266,18 +266,109 @@ class RowPackedSaturationEngine:
             else max(temp_budget_bytes // 2 // (self.nc * 4), 1)
         )
 
-        def mm_chunks(plan):
-            """[(raw_ids, inv, piece)]: the matmul runs over the chunk's
-            unique raw axioms; ``raw_ids[inv]`` restores the seg-OR's
-            repeat-padded emission order."""
+        # ---- ROLE-AWARE row chunking for CR4/CR6.  The axiom tables
+        # arrive role-sorted (core/indexing: nf4 by s, chain_pairs by
+        # first-leg role), so chunks cut at role-run boundaries keep
+        # each chunk's relevant link set — links whose role is a
+        # subrole of some chunk row's role — small, and the static
+        # live-tile schedule (build_tiles below) then contracts only
+        # those links: the reference's per-role hash-join partitioning
+        # (RolePairHandler.java:396-444) as a static schedule.  Runs
+        # merge greedily while the merged chunk's (rows × live links)
+        # MAC volume stays within ``waste`` of the parts' sum, so
+        # role-poor corpora still get few big MXU-friendly chunks; the
+        # waste factor relaxes until the chunk count (≈ traced program
+        # size) fits the compile budget.
+        h_clo = idx.role_closure
+        n_roles_all = h_clo.shape[0]
+        link_cnt = (
+            np.bincount(idx.links[:, 0], minlength=n_roles_all)
+            if idx.n_links
+            else np.zeros(n_roles_all, np.int64)
+        )
+        # role-aware splitting (and the role-resolution L-window cap
+        # below) engage only when a table's DENSE contraction volume is
+        # super-TFLOP — below that, pruning saves sub-0.1s of chip time
+        # while growing the traced program (≈ compile time)
+        rows_max = max(
+            len(idx.nf4) if self._has4 else 0,
+            len(idx.chain_pairs) if self._has6 else 0,
+        )
+        big_tables = rows_max * self.nl * self.nc >= 5e11
+
+        def role_chunks(tab_roles, tab_targets):
+            """[(raw_ids, inv, piece)] — ``raw_ids`` a contiguous
+            role-sorted row range, ``piece`` a LOCAL seg-OR plan over
+            the chunk's targets, ``inv`` its emission order."""
+            n = len(tab_roles)
+            if n == 0:
+                return []
+            if not big_tables:
+                # the whole table's DENSE contraction is sub-TFLOP —
+                # role-splitting would only grow the traced program
+                # (≈ compile time); plain row-budget spans suffice
+                spans = [
+                    (o, min(o + mm_rows, n)) for o in range(0, n, mm_rows)
+                ]
+                return [
+                    (
+                        np.arange(a0, a1),
+                        (p := SegmentedRowOr(tab_targets[a0:a1])).order,
+                        p,
+                    )
+                    for a0, a1 in spans
+                ]
+            starts = np.flatnonzero(
+                np.r_[True, tab_roles[1:] != tab_roles[:-1]]
+            )
+            ends = np.r_[starts[1:], n]
+            # oversized single-role runs split by the row budget alone
+            pieces = []
+            for s, e in zip(starts, ends):
+                rho = int(tab_roles[s])
+                for o in range(s, e, mm_rows):
+                    pieces.append((o, min(o + mm_rows, e), rho))
+
+            def greedy(waste):
+                out, cur = [], None
+                for s, e, rho in pieces:
+                    rset = h_clo[:, rho] > 0
+                    rmacs = (e - s) * int(link_cnt[rset].sum())
+                    if cur is None:
+                        cur = [s, e, rset.copy(), rmacs]
+                        continue
+                    nrows = e - cur[0]
+                    nset = cur[2] | rset
+                    nmacs = nrows * int(link_cnt[nset].sum())
+                    if nrows <= mm_rows and nmacs <= waste * (
+                        cur[3] + rmacs
+                    ):
+                        cur[1], cur[2], cur[3] = e, nset, cur[3] + rmacs
+                    else:
+                        out.append((cur[0], cur[1]))
+                        cur = [s, e, rset.copy(), rmacs]
+                if cur is not None:
+                    out.append((cur[0], cur[1]))
+                return out
+
+            for waste in (1.25, 2.0, 4.0, float("inf")):
+                spans = greedy(waste)
+                if len(spans) <= 48:
+                    break
             out = []
-            for sl, piece in plan.split(mm_rows) if plan else []:
-                raw_ids, inv = np.unique(plan.order[sl], return_inverse=True)
-                out.append((raw_ids, inv, piece))
+            for a0, a1 in spans:
+                piece = SegmentedRowOr(tab_targets[a0:a1])
+                out.append((np.arange(a0, a1), piece.order, piece))
             return out
 
-        self._cr4_chunks = mm_chunks(self._p4)
-        self._cr6_chunks = mm_chunks(self._p6)
+        self._cr4_chunks = (
+            role_chunks(idx.nf4[:, 0], idx.nf4[:, 2]) if self._has4 else []
+        )
+        self._cr6_chunks = (
+            role_chunks(idx.chain_pairs[:, 0], idx.chain_pairs[:, 2])
+            if self._has6
+            else []
+        )
         # The contraction (link) axis is chunked too: a realistic
         # many-role corpus at 96k classes has ~100k links, so the
         # per-step [rk, nl] i8 operand (mask ∧ bit-table) alone would
@@ -298,6 +389,18 @@ class RowPackedSaturationEngine:
                 _pad_up(max(temp_budget_bytes // 2 // max(max_rk, 1), 32), 32),
                 self.nl,
             )
+            if big_tables:
+                # resolve the link table's role runs: windows near the
+                # mean run size let the static live-tile schedule skip
+                # off-role links (256 floor keeps the MXU contraction
+                # dimension efficient)
+                n_link_roles = int(
+                    len(np.unique(idx.links[:, 0])) if idx.n_links else 1
+                )
+                role_lc = _pad_up(
+                    -(-self.nl // max(n_link_roles, 1)), 32
+                )
+                lc = min(lc, max(role_lc, 256))
         self.n_lchunks = -(-self.nl // lc)
         # even the chunks out: taking the budget maximum as-is can round
         # nl up by almost a whole chunk of inert links (R rows + mask
@@ -321,13 +424,19 @@ class RowPackedSaturationEngine:
             self._p1.k,
             2 * self._p2.k,  # two gathers live at once
             self._p3.k,
-            (2 * self.nl) if self._bottom else 0,  # CR5 mask + reduce
             1,
         )
         bw = temp_budget_bytes // (4 * emission_max)
         if bw >= 128:
             bw = bw // 128 * 128  # lane-aligned slices when affordable
         bw = max(min(bw, wl), 1)
+        n_sblocks = -(-wl // bw)
+        # even the blocks out (cf. the lc plan above): taking the budget
+        # maximum as-is can leave the overlapping last block re-deriving
+        # almost a whole block of words every superstep
+        bw = -(-wl // n_sblocks)
+        if bw >= 128:
+            bw = min(_pad_up(bw, 128), wl)
         self._bw = bw
         self._n_sblocks = -(-wl // bw)
 
@@ -357,18 +466,92 @@ class RowPackedSaturationEngine:
             self._link_roles[: idx.n_links] = link_roles
 
         m4 = np.zeros((0, n_roles + 1), np.int8)
-        if self._p4 is not None:
+        if self._has4:
             # m4[j, ρ] = H[ρ, s_j] — the link's role must be a
             # (transitive) subrole of the axiom's s
             m4 = np.ascontiguousarray(h2[:, idx.nf4[:, 0]].T)
         m6 = np.zeros((0, n_roles + 1), np.int8)
-        if self._p6 is not None:
+        if self._has6:
             # m6[p, ρ] = H[ρ, r_p] — first-leg subrole closure
             m6 = np.ascontiguousarray(h2[:, idx.chain_pairs[:, 0]].T)
-        self._masks = (jnp.asarray(m4), jnp.asarray(m6))
+
+        # ---- static live-tile schedule: each CR4/CR6 row chunk
+        # contracts ONLY the L-windows containing links whose role is a
+        # (transitive) subrole of some axiom role in the chunk.  Roles
+        # are fixed at index time and links are interned role-grouped
+        # (core/indexing.role_sort_links), so the relevant links form a
+        # few contiguous runs and the windows covering them are a static
+        # per-chunk table — the contraction loop drives over it with
+        # traced offsets (dynamic slices), skipping the ~93-98% of the
+        # (rows × links) plane the role-block structure keeps dead
+        # (the reference's per-role hash join partitioning,
+        # RolePairHandler.java:396-444, as a static schedule).  Window
+        # edges may include off-role links: their factored-mask entries
+        # are 0, so they contribute nothing (and windows clamped at the
+        # link-table tail re-derive earlier links — OR is idempotent).
+        # Chunks with NO relevant links are dropped outright.
+        def build_tiles(chunks, role_of):
+            kept, tiles = [], []
+            lcn = self.lc
+            for raw, inv, piece in chunks:
+                croles = np.unique(role_of(raw))
+                rel = np.flatnonzero(h[:, croles].any(axis=1))
+                live = np.flatnonzero(np.isin(self._link_roles, rel))
+                if live.size == 0:
+                    continue
+                offs = []
+                i = 0
+                while i < live.size:
+                    off = min(int(live[i]), self.nl - lcn)
+                    offs.append(off)
+                    i = int(np.searchsorted(live, off + lcn))
+                offs = np.asarray(offs, np.int32)
+                fill_t = np.stack(
+                    [self._fillers[o : o + lcn] for o in offs]
+                ).astype(np.int32)
+                lrole_t = np.stack(
+                    [self._link_roles[o : o + lcn] for o in offs]
+                )
+                # aligned dirty_l chunks a window overlaps (≤ 2)
+                c01 = np.stack(
+                    [
+                        offs // lcn,
+                        np.minimum(
+                            (offs + lcn - 1) // lcn, self.n_lchunks - 1
+                        ),
+                    ],
+                    axis=1,
+                ).astype(np.int32)
+                kept.append((raw, inv, piece))
+                tiles.append(
+                    (
+                        jnp.asarray(offs),
+                        jnp.asarray(fill_t),
+                        jnp.asarray(lrole_t),
+                        jnp.asarray(c01),
+                    )
+                )
+            return kept, tiles
+
+        self._cr4_chunks, self._cr4_tiles = build_tiles(
+            self._cr4_chunks, lambda raw: idx.nf4[raw, 0]
+        )
+        self._cr6_chunks, self._cr6_tiles = build_tiles(
+            self._cr6_chunks, lambda raw: idx.chain_pairs[raw, 0]
+        )
+        # the whole plan-table pytree (closure masks + live-tile
+        # schedules) stays an ARGUMENT to the jitted run — embedded
+        # constants get serialized into every remote compile request
+        # and replicated per shard
+        self._masks = (
+            jnp.asarray(m4),
+            jnp.asarray(m6),
+            tuple(self._cr4_tiles),
+            tuple(self._cr6_tiles),
+        )
 
         # one packed-output matmul plan per row-chunk, shared by every
-        # (equal-sized) L-chunk.  dtype: forwarded only when the caller
+        # (equal-sized) L-window.  dtype: forwarded only when the caller
         # pinned one — the Pallas kernel's own default (bf16 on TPU) wins
         # otherwise; the engine's int8 preference applies to the
         # XLA-formulated lookups/tables
@@ -643,7 +826,8 @@ class RowPackedSaturationEngine:
         replicated 3-tuple frontier carry between state and masks."""
         P = jax.sharding.PartitionSpec
         state = P(None, self.word_axis)
-        masks = (P(None, None), P(None, None))
+        # plan tables (masks + live-tile schedules): replicated leaves
+        masks = jax.tree.map(lambda _: P(), self._masks)
         in_specs = (
             (state, state, P(None), masks)
             if with_dirty
@@ -699,9 +883,9 @@ class RowPackedSaturationEngine:
         for raw, _inv, plan in self._cr6_chunks:
             readers.append(("RR", None))
         if self._bottom:
-            # CR5 keeps its gate inside the word-block sweep (always the
-            # LAST flag): its masked OR-reduce sweeps all of R_T, which
-            # unlike CR1-3's axiom-count-bound gathers scales with nl·wc
+            # CR5's masked OR-reduce sweeps all of R_T (scales with
+            # nl·wc, unlike CR1-3's axiom-count-bound gathers), so it
+            # keeps its gate; always the LAST flag
             readers.append(("CR5", None))
 
         # R-side masks are unnecessary for the GATE: every R reader
@@ -754,15 +938,27 @@ class RowPackedSaturationEngine:
             # block slice + write-back traffic of the word sweep
             rw += 2 * (self.nc + self.nl) * w4
         macs = 0
-        for chunks in (self._cr4_chunks, self._cr6_chunks):
-            for raw, _inv, piece in chunks:
-                rw += self.nl * w4                       # full R_T sweep
+        live_macs = 0
+        for chunks, tiles in (
+            (self._cr4_chunks, self._cr4_tiles),
+            (self._cr6_chunks, self._cr6_tiles),
+        ):
+            for (raw, _inv, piece), tile in zip(chunks, tiles):
+                n_t = int(tile[0].shape[0])
+                rw += n_t * self.lc * w4                 # live R windows
                 rw += len(raw) * w4                      # subt gather
                 rw += 2 * piece.n_targets * w4           # target RMW
                 macs += len(raw) * self.nl * self.nc
+                live_macs += len(raw) * n_t * self.lc * self.nc
         if self._bottom:
             rw += (self.nl + 2) * w4
-        return {"hbm_bytes": rw, "mm_dense_equiv_macs": macs}
+        return {
+            "hbm_bytes": rw,
+            "mm_dense_equiv_macs": macs,
+            # the statically-scheduled portion actually contracted (live
+            # role windows only) — what the chip really has to beat
+            "mm_live_macs": live_macs,
+        }
 
     def _next_dirty(self, mask_s, any_r, axis_name):
         """End-of-step rule-gate flags from the shared changed-S-row
@@ -827,7 +1023,7 @@ class RowPackedSaturationEngine:
         whole-array post-comparison, so the pre-step state is dead as
         soon as the last rule reads it — without this the fixed-point
         loop carries two full copies of S and OOMs ~2x earlier."""
-        m4, m6 = self._masks if masks is None else masks
+        m4, m6, t4, t6 = self._masks if masks is None else masks
         gating = self._gate is not None
         if dirty is None:  # stateless public step(): all-dirty
             dirty = self.initial_dirty()
@@ -858,24 +1054,17 @@ class RowPackedSaturationEngine:
                 operand,
             )
 
-        # ---- CR1/CR2/CR3/CR5: full static plans, swept over word
-        # blocks.  Each rule is column-local (a row write's word w
-        # depends only on its sources' word w), so a [rows, bw] block is
-        # a complete sub-problem; the sweep bounds temporaries to
-        # O(K·bw) with ONE traced body regardless of corpus size —
-        # per-axiom chunking compiled one body per chunk and XLA compile
-        # time grew super-linearly in chunk count (74 min at 300k
-        # classes).  CR5's ⊥-filler mask is the one column-global input
-        # (bits at filler columns anywhere in the row), so it is
-        # computed full-width before the sweep — reading the pre-sweep
-        # S_T[⊥] only delays a consequence into the next superstep,
-        # which the no-change convergence vote never misses.
-        cv5 = None
-        if self._p1.k or self._p2.k or self._p3.k or self._bottom:
-            botf = None
-            if self._bottom:
-                bt = self._bit_table(sp, np.full(1, BOTTOM_ID), axis_name)
-                botf = bt[:, 0].astype(bool)  # [nl]
+        # ---- CR1/CR2/CR3: full static plans, swept over word blocks.
+        # Each rule is column-local (a row write's word w depends only
+        # on its sources' word w), so a [rows, bw] block is a complete
+        # sub-problem; the sweep bounds temporaries to O(K·bw) with ONE
+        # traced body regardless of corpus size — per-axiom chunking
+        # compiled one body per chunk and XLA compile time grew
+        # super-linearly in chunk count (74 min at 300k classes).
+        # CR5 stays a full-width op after CR6 (its ⊥-filler mask reads
+        # bit columns anywhere in the row, and its masked-reduce
+        # temporary is O(nl·width) regardless of blocking).
+        if self._p1.k or self._p2.k or self._p3.k:
 
             def block_rules(sb, rb):
                 cvs = []
@@ -894,31 +1083,6 @@ class RowPackedSaturationEngine:
                     red = self._p3.reduce(sb[jnp.asarray(self._src3)])
                     rb, cv = self._p3.write(rb, red, track="rows")
                     cvs.append(cv)
-                if self._bottom:  # CR5: ⊥ back-propagation
-
-                    def red5(r):
-                        masked = jnp.where(
-                            botf[:, None], r, jnp.asarray(0, jnp.uint32)
-                        )
-                        return lax.reduce(
-                            masked, np.uint32(0), lax.bitwise_or, (0,)
-                        )
-
-                    if gating:
-                        # CR5's flag is always the LAST gate flag; only
-                        # the [bw] reduced row crosses the cond boundary
-                        red = lax.cond(
-                            gate_flags[self._gate["n_flags"] - 1],
-                            red5,
-                            lambda r: jnp.zeros((rb.shape[1],), jnp.uint32),
-                            rb,
-                        )
-                    else:
-                        red = red5(rb)
-                    old = sb[BOTTOM_ID]
-                    merged = old | red
-                    sb = sb.at[BOTTOM_ID].set(merged)
-                    cvs.append(jnp.any(merged != old)[None])
                 return sb, rb, cvs
 
             if self._n_sblocks == 1:
@@ -933,17 +1097,23 @@ class RowPackedSaturationEngine:
                     zeros.append(jnp.zeros(self._p2.n_targets, bool))
                 if self._p3.k:
                     zeros.append(jnp.zeros(self._p3.n_targets, bool))
-                if self._bottom:
-                    zeros.append(jnp.zeros(1, bool))
 
                 def body(bi, carry):
                     sp, rp, cvs = carry
                     off = jnp.minimum(bi * bw, width - bw)
                     sb = lax.dynamic_slice(sp, (0, off), (nrows_s, bw))
-                    rb = lax.dynamic_slice(rp, (0, off), (nrows_r, bw))
+                    # slice/write back only the matrices the active
+                    # rules touch (an inert R copy per block otherwise)
+                    rb = (
+                        lax.dynamic_slice(rp, (0, off), (nrows_r, bw))
+                        if self._p3.k
+                        else rp
+                    )
                     sb, rb, cv = block_rules(sb, rb)
-                    sp = lax.dynamic_update_slice(sp, sb, (0, off))
-                    rp = lax.dynamic_update_slice(rp, rb, (0, off))
+                    if self._p1.k or self._p2.k:
+                        sp = lax.dynamic_update_slice(sp, sb, (0, off))
+                    if self._p3.k:
+                        rp = lax.dynamic_update_slice(rp, rb, (0, off))
                     return sp, rp, [a | b for a, b in zip(cvs, cv)]
 
                 sp, rp, cvs = lax.fori_loop(
@@ -962,9 +1132,6 @@ class RowPackedSaturationEngine:
                 cv = next(cvs)
                 r_vecs.append(cv)
                 ch |= jnp.any(cv)
-            if self._bottom:
-                cv5 = next(cvs)  # appended to s_vecs after CR4 (writer
-                ch |= jnp.any(cv5)  # order: CR1, CR2, CR4 chunks, CR5)
         # CR4: ∃s.a ⊑ b — packed-columns MXU matmul: R_T stays uint32 in
         # HBM end to end (the Pallas kernel unpacks/repacks per VMEM tile;
         # the XLA fallback materializes the wide operands instead).  The
@@ -978,61 +1145,70 @@ class RowPackedSaturationEngine:
         dt = self.matmul_dtype
         lc = self.lc
         wlw = rp.shape[1]
-        fillers2d = jnp.asarray(
-            self._fillers.reshape(self.n_lchunks, lc).astype(np.int32)
-        )
-        lr2d = jnp.asarray(self._link_roles.reshape(self.n_lchunks, lc))
         base = (
             None
             if axis_name is None
             else lax.axis_index(axis_name) * (self.wc // self.n_shards)
         )
 
-        def contract_from(bits_state, rp_state, rows, mask_rows, mm, f_dirty):
+        def contract_from(
+            bits_state, rp_state, rows, mask_rows, mm, f_dirty, tiles
+        ):
             """``f_dirty``: scalar — did any bit-table SOURCE row of this
-            chunk change last step?  An L-iteration whose R slice is also
-            clean (``dirty_l[i]``) re-derives nothing (OR-monotone), so
-            its ``w`` operand is zeroed and the kernel's per-tile skip
-            flags drop the MXU work — the reference's two-sided
-            semi-naive join in tensor form."""
+            chunk change last step?  A live window whose R slice is also
+            clean (``dirty_l`` over the aligned chunks it overlaps)
+            re-derives nothing (OR-monotone), so its ``w`` operand is
+            zeroed and the kernel's per-tile skip flags drop the MXU
+            work — the reference's two-sided semi-naive join in tensor
+            form.  ``tiles`` is this chunk's static live-window table
+            (see ``build_tiles`` in ``__init__``): the loop contracts
+            only windows whose link roles can satisfy the chunk's
+            axiom roles."""
+            offs, fill_t, lrole_t, c01 = tiles
+            n_t = int(offs.shape[0])
             rk = len(rows)
             subt = bits_state[jnp.asarray(rows)].T        # [W, rk], hoisted
 
             def one(i, acc):
                 if axis_name is None:
-                    f = bit_lookup_from(subt, fillers2d[i], dtype=dt)
+                    f = bit_lookup_from(subt, fill_t[i], dtype=dt)
                 else:
                     f = lax.psum(
                         bit_lookup_from(
-                            subt, fillers2d[i],
+                            subt, fill_t[i],
                             word_offset=base, dtype=jnp.int32,
                         ),
                         axis_name,
                     ).astype(dt)                          # [lc, rk]
-                live = (dirty_l[i] | f_dirty).astype(dt)
+                live = (
+                    dirty_l[c01[i, 0]] | dirty_l[c01[i, 1]] | f_dirty
+                ).astype(dt)
                 # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
                 w = (
-                    jnp.take(mask_rows, lr2d[i], axis=1).astype(dt)
+                    jnp.take(mask_rows, lrole_t[i], axis=1).astype(dt)
                     * f.T
                     * live
                 )
-                b = lax.dynamic_slice(rp_state, (i * lc, 0), (lc, wlw))
+                b = lax.dynamic_slice(
+                    rp_state, (offs[i], 0), (lc, wlw)
+                )
                 return acc | mm(w, b)
 
-            if self.n_lchunks == 1:
+            if n_t == 1:
                 return one(0, jnp.zeros((rk, wlw), jnp.uint32))
             return lax.fori_loop(
-                0, self.n_lchunks, one, jnp.zeros((rk, wlw), jnp.uint32)
+                0, n_t, one, jnp.zeros((rk, wlw), jnp.uint32)
             )
 
-        if self._p4 is not None:
+        if self._has4:
             for k, ((raw, inv, plan), mm) in enumerate(
                 zip(self._cr4_chunks, self._cr4_mm)
             ):
                 a4rows = self._a4rows[k]
+                tiles = t4[k]
 
                 def red4(ops, raw=raw, inv=inv, plan=plan, mm=mm,
-                         a4rows=a4rows):
+                         a4rows=a4rows, tiles=tiles):
                     s, r = ops
                     f_dirty = (
                         jnp.any(s_changed[jnp.asarray(a4rows)])
@@ -1040,7 +1216,7 @@ class RowPackedSaturationEngine:
                         else jnp.asarray(False)
                     )
                     out = contract_from(
-                        s, r, self._a4[raw], m4[raw], mm, f_dirty
+                        s, r, self._a4[raw], m4[raw], mm, f_dirty, tiles
                     )
                     return plan.reduce(out[inv])
 
@@ -1049,20 +1225,22 @@ class RowPackedSaturationEngine:
                 s_vecs.append(cv)
                 ch |= jnp.any(cv)
         # CR6: role chains
-        if self._p6 is not None:
+        if self._has6:
             for k, ((raw, inv, plan), mm) in enumerate(
                 zip(self._cr6_chunks, self._cr6_mm)
             ):
                 l2c = self._l2chunks6[k]
+                tiles = t6[k]
 
-                def red6(r, raw=raw, inv=inv, plan=plan, mm=mm, l2c=l2c):
+                def red6(r, raw=raw, inv=inv, plan=plan, mm=mm, l2c=l2c,
+                         tiles=tiles):
                     f_dirty = (
                         jnp.any(dirty_l[jnp.asarray(l2c)])
                         if len(l2c)
                         else jnp.asarray(False)
                     )
                     out = contract_from(
-                        r, r, self._l26[raw], m6[raw], mm, f_dirty
+                        r, r, self._l26[raw], m6[raw], mm, f_dirty, tiles
                     )
                     return plan.reduce(out[inv])
 
@@ -1070,10 +1248,28 @@ class RowPackedSaturationEngine:
                 rp, cv = plan.write(rp, red, track="rows")
                 r_vecs.append(cv)
                 ch |= jnp.any(cv)
-        # CR5 ran inside the word-block sweep; its change vector slots
-        # into writer order here (CR1, CR2, CR4 chunks, CR5)
-        if cv5 is not None:
-            s_vecs.append(cv5)
+        # CR5: ⊥ back-propagation — one masked packed OR-reduce (its
+        # gate flag is always the LAST one, after the CR4/CR6 chunks)
+        if self._bottom:
+
+            def red5(ops):
+                s, r = ops
+                botf = self._bit_table(s, np.full(1, BOTTOM_ID), axis_name)
+                mask = botf[:, 0].astype(bool)              # [nl]
+                masked = jnp.where(
+                    mask[:, None], r, jnp.asarray(0, jnp.uint32)
+                )
+                return lax.reduce(
+                    masked, np.uint32(0), lax.bitwise_or, (0,)
+                )[None]
+
+            red = gated_rows(1, (sp, rp), red5)
+            old5 = sp[BOTTOM_ID]
+            merged5 = old5 | red[0]
+            sp = sp.at[BOTTOM_ID].set(merged5)
+            cv = jnp.any(merged5 != old5)[None]
+            s_vecs.append(cv)
+            ch |= jnp.any(cv)
         mask_s, any_r, dirty_l_next = self._next_frontier(s_vecs, r_vecs)
         gate_next = (
             self._next_dirty(mask_s, any_r, axis_name)

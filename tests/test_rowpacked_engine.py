@@ -219,7 +219,7 @@ def test_sharded_rowpacked_multiblock_sweep(small, mesh8):
     local = RowPackedSaturationEngine(idx).saturate()
     # two shards leave a wide enough shard-local word axis to block
     mesh2 = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("c",))
-    eng = RowPackedSaturationEngine(idx, mesh=mesh2, temp_budget_bytes=256)
+    eng = RowPackedSaturationEngine(idx, mesh=mesh2, temp_budget_bytes=64)
     assert eng._n_sblocks > 1
     sharded = eng.saturate()
     assert sharded.derivations == local.derivations
