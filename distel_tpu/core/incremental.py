@@ -14,22 +14,22 @@ arrays, and run the fixed point again.  Iterations needed ≈ the depth of
 tensor-shaped analog of semi-naive delta evaluation.
 
 Retrace amortization — the **delta fast path** (``_delta_fast_path``):
-for class-only deltas (no new links, roles, or chain pairs — the
-dominant streaming shape) over a base of ≥32k concepts, the base
-corpus's compiled program is reused as-is and only a small program over
-the delta's own axiom rows is compiled; the two alternate to a joint
-fixed point.  Soundness rests on the transposed packed layout: the base
-program's rules operate on subsumer/link ROWS, and the delta's new
-concepts are new bit LANES inside the base engine's padding, which
-every row op processes correctly without knowing they exist.  Measured
-at 48k classes: 7-10.6 s per 50-200-axiom delta vs 13.3-14.3 s for the
-full rebuild — and unlike the rebuild, the fast path's cost does not
-grow with the corpus (the base program never recompiles).  Deltas that
-add links/roles/chains, overflow the concept padding, or arrive on a
-small corpus take the full-rebuild path unchanged.  The remaining
-general fix — cross-term programs for (old axioms x new links), the
-reference's two-sided increment join — stays deferred to its own
-verification round.
+over a base of ≥32k concepts, the base corpus's compiled program is
+reused as-is and only small delta programs compile.  Soundness rests on
+the transposed packed layout: the base program's rules operate on
+subsumer/link ROWS; the delta's new concepts are new bit LANES inside
+the base engine's padding, which every row op processes correctly
+without knowing they exist, and the delta's new LINKS are padding rows
+the base program's stale tables keep inert (sentinel roles, ⊤
+fillers).  Class-only deltas run one delta program (the delta's own
+axiom rows); link-creating deltas — the reference's property-assertion
+traffic shape (``scripts/traffic-data-load-classify.sh``) — add the
+CROSS program: the full CR4/CR6 tables contracted against only the
+new-link window, the tensor form of the reference's two-sided T3₂
+increment join (``base/Type3_2AxiomProcessorBase.java:100-174``).  All
+programs round-robin with the base program to a joint fixed point.
+Deltas that add roles, change the role hierarchy, or overflow a
+padding reservation take the full-rebuild path unchanged.
 """
 
 from __future__ import annotations
@@ -65,6 +65,12 @@ class IncrementalClassifier:
     #: later class-only deltas reuse its compiled program (new concepts
     #: are new bit lanes inside the existing padding)
     _CAPACITY_PAD = 2048
+
+    #: extra link-ROW headroom reserved by the full rebuild: a later
+    #: link-creating delta parks its new links in these rows (where the
+    #: base program's stale tables keep them inert — sentinel roles,
+    #: ⊤ fillers) instead of forcing a rebuild
+    _LINK_PAD = 2048
 
     #: below this many base concepts the full rebuild is cheaper than
     #: the fast path's fixed compile costs (see _delta_fast_path)
@@ -143,7 +149,16 @@ class IncrementalClassifier:
         # are useless once a rebuild starts — free them before the new
         # engine allocates
         self._base_engine = self._base_idx = None
-        engine = make_engine(cfg, idx, mesh=self._mesh)
+        engine = make_engine(
+            cfg,
+            idx,
+            mesh=self._mesh,
+            # reservations for later deltas: concept-lane headroom even
+            # when n_concepts lands exactly on a pad boundary, and link
+            # rows for the cross-term path's new links
+            min_concepts=idx.n_concepts + self._CAPACITY_PAD,
+            min_links_pad=idx.n_links + self._LINK_PAD,
+        )
         # hand the old closure over without keeping a reference in this
         # frame: the embed copies it into the grown arrays, and holding
         # the old device buffers through the run would add a full extra
@@ -161,21 +176,29 @@ class IncrementalClassifier:
         return result
 
     def _delta_fast_path(self, idx) -> Optional[SaturationResult]:
-        """Reuse the base corpus's compiled program for a class-only
-        delta — the amortization the reference gets from its increments
-        being plain Redis inserts (``init/AxiomLoader.java:119-129``).
+        """Reuse the base corpus's compiled program for a delta — the
+        amortization the reference gets from its increments being plain
+        Redis inserts (``init/AxiomLoader.java:119-129``).
 
-        Eligible when the delta adds no links, no roles, no chain pairs,
-        and its new concepts fit the base engine's padding: then the base
-        program is CORRECT as-is over the grown state (its rules operate
-        on subsumer/link ROWS; new concepts are new bit lanes of the
-        transposed packed state, which every row op processes blindly),
-        and only a small engine over the delta's own axiom rows is
-        compiled.  The two alternate to a joint fixed point.  Termination
-        uses the engines' RAW change signal (``iterations > unroll`` ⇔
-        some vote derived something): the base engine's derivation
-        *count* masks bit lanes past its own concept universe, so a
-        counted zero could lie about lanes it derived into."""
+        Eligible when the delta's new concepts fit the base engine's
+        concept-lane padding and its new links (if any) fit the reserved
+        link rows, with roles and the role hierarchy unchanged: then the
+        base program is CORRECT as-is over the grown state (its rules
+        operate on subsumer/link ROWS; new concepts are new bit lanes of
+        the transposed packed state, which every row op processes
+        blindly; new links sit in padding rows its stale tables keep
+        inert) and only small delta programs compile:
+
+        * B — the delta's own axiom rows against the full state;
+        * A — (link-creating deltas only) the FULL CR4/CR6 tables
+          against the new-link window: together with B these are the
+          two one-sided halves of the reference's two-sided T3₂
+          increment join (``base/Type3_2AxiomProcessorBase.java:100-174``,
+          dual score cursors ``Type3_2AxiomProcessor.java:99-106``).
+
+        The programs round-robin with the base program to a joint fixed
+        point.  Deltas that add roles, change the role hierarchy, or
+        overflow a padding reservation take the full-rebuild path."""
         base, b = self._base_engine, self._base_idx
         if base is None or self._state is None:
             return None
@@ -193,105 +216,160 @@ class IncrementalClassifier:
         from distel_tpu.core.engine import _host_bit_total, fetch_global
         from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
 
+        links_grew = idx.n_links > b.n_links
         if (
             idx.n_concepts > base.nc
-            or idx.n_links != b.n_links
+            or idx.n_links < b.n_links
+            or idx.n_links > base.nl  # new links must fit the reserved rows
             or idx.n_roles != b.n_roles
-            or len(idx.chain_pairs) != len(b.chain_pairs)
+            or len(idx.chain_pairs) < len(b.chain_pairs)
             or not np.array_equal(idx.role_closure, b.role_closure)
         ):
             return None
-        # the delta program carries only the delta's own axiom rows —
-        # giving it the full CR1/CR2 tables was measured SLOWER (the
-        # per-delta compile of 48k-row plans outweighs the base votes it
-        # saves); the base pass closes cross-hierarchy consequences at
-        # one level per vote, which the reused compiled program does at
-        # ~0.35 s/vote.  nf1-nf3 are appended in arrival order, so the
-        # tail slice IS the delta; nf4 is globally SORTED by the indexer
-        # (indexing.py: nf4_rows.sort()), so its delta must be a set
-        # difference — a positional slice would drop a new axiom that
-        # sorts into the prefix from BOTH programs (silent incompleteness).
-        def _nf4_delta():
-            if len(idx.nf4) == len(b.nf4):
-                return idx.nf4[:0]
-            span = np.int64(max(idx.n_concepts, 1))
+        # Prefix/containment integrity guards: the slicing below assumes
+        # the re-indexed accumulated ontology keeps every base row.  That
+        # is the indexer's append-only contract, but nothing enforces it
+        # at runtime — a future dedup/reorder would silently drop axioms
+        # from BOTH programs (incomplete closure) or leave the reused
+        # base program reading rows under a stale order (unsound).
+        for new, old in (
+            (idx.nf1, b.nf1),
+            (idx.nf2, b.nf2),
+            (idx.nf3, b.nf3),
+            (idx.links, b.links),
+        ):
+            if len(new) < len(old) or not np.array_equal(
+                new[: len(old)], old
+            ):
+                return None
+
+        # nf4 / chain_pairs are globally SORTED by the indexer, so their
+        # deltas are SET DIFFERENCES — a positional tail slice would drop
+        # a new row that sorts into the prefix from BOTH programs (silent
+        # incompleteness) — and every base row must still be present
+        span = np.int64(
+            max(idx.n_concepts, idx.n_links, idx.n_roles, 2)
+        )
+
+        def _sorted_delta(new, old):
+            """(delta_rows, base_rows_all_survive)."""
             key = lambda t: (
                 t[:, 0].astype(np.int64) * span + t[:, 1]
             ) * span + t[:, 2]
-            return idx.nf4[~np.isin(key(idx.nf4), key(b.nf4))]
+            if len(old) == 0:
+                return new, True
+            kn, ko = key(new), key(old)
+            return new[~np.isin(kn, ko)], bool(np.isin(ko, kn).all())
 
+        nf4_delta, nf4_ok = _sorted_delta(idx.nf4, b.nf4)
+        cp_delta, cp_ok = _sorted_delta(idx.chain_pairs, b.chain_pairs)
+        if not (nf4_ok and cp_ok):
+            return None
+
+        # ---- engine roster.  The delta program (B) carries only the
+        # delta's own axiom rows — giving it the full CR1/CR2 tables was
+        # measured SLOWER (the per-delta compile of 48k-row plans
+        # outweighs the base votes it saves); the base pass closes
+        # cross-hierarchy consequences at one level per vote at
+        # ~0.35 s/vote of reused compiled program.  When the delta
+        # CREATED LINKS (the reference's property-assertion traffic,
+        # ``scripts/traffic-data-load-classify.sh``), a third CROSS
+        # program (A) joins the FULL nf4/chain tables against ONLY the
+        # new-link window — together with B (new rows × all links) these
+        # are the two one-sided halves of the reference's T3₂ increment
+        # join (``base/Type3_2AxiomProcessorBase.java:100-174``).  The
+        # new links live in the base program's reserved link-row padding
+        # (``_LINK_PAD``), where its stale tables keep them inert.
         delta_idx = dataclasses.replace(
             idx,
             nf1=idx.nf1[len(b.nf1):],
             nf2=idx.nf2[len(b.nf2):],
             nf3=idx.nf3[len(b.nf3):],
-            nf4=_nf4_delta(),
+            nf4=nf4_delta,
+            chain_pairs=cp_delta,
         )
-        # the delta program carries only the rules its axiom slices
-        # need — CR6 stays with the base program (no new chain pairs);
-        # CR5 is structural over the full link table, so it joins the
-        # delta only when the delta introduces the first bottom axioms
         rules = set()
         for name, tab in (
             ("CR1", delta_idx.nf1),
             ("CR2", delta_idx.nf2),
             ("CR3", delta_idx.nf3),
             ("CR4", delta_idx.nf4),
+            ("CR6", delta_idx.chain_pairs),
         ):
             if len(tab):
                 rules.add(name)
-
-        if idx.has_bottom_axioms and not base._bottom:
+        # CR5 sweeps the full link table: the delta program carries it
+        # when the base never compiled it, or when new links exist that
+        # the base program's stale filler table cannot see
+        if idx.has_bottom_axioms and (links_grew or not base._bottom):
             rules.add("CR5")
-        if not rules:
-            return None  # nothing new for the engines: rebuild path
-        delta_engine = RowPackedSaturationEngine(
-            delta_idx,
-            # state shapes must match the base program's exactly
+
+        shape_kw = dict(
+            # state shapes must match the base program's exactly; pinning
+            # the base's L-window width keeps the link-axis chunk
+            # evening from drifting nl away from base.nl
             pad_multiple=base.nc,
             min_links_pad=base.nl,
+            l_chunk=base.lc,
             mesh=self._mesh,
             matmul_dtype=self.config.matmul_jnp_dtype(),
-            rules=frozenset(rules),
         )
-        if (delta_engine.nc, delta_engine.nl) != (base.nc, base.nl):
+        engines = []
+        if rules:
+            engines.append(
+                RowPackedSaturationEngine(
+                    delta_idx, rules=frozenset(rules), **shape_kw
+                )
+            )
+        if links_grew:
+            cross_rules = set()
+            if len(idx.nf4):
+                cross_rules.add("CR4")
+            if len(idx.chain_pairs):
+                cross_rules.add("CR6")
+            if cross_rules:
+                engines.append(
+                    RowPackedSaturationEngine(
+                        idx,  # FULL tables × the new-link window only
+                        rules=frozenset(cross_rules),
+                        link_window=(b.n_links, idx.n_links),
+                        **shape_kw,
+                    )
+                )
+        if not engines:
+            return None  # nothing new for the engines: rebuild path
+        if any((e.nc, e.nl) != (base.nc, base.nl) for e in engines):
             return None  # layouts still diverge: take the rebuild path
+        engines.append(base)
         self.last_result = None
         # a one-slot box keeps this frame from pinning any state tuple
         # through a saturate call (a held reference would add a full
         # extra S_T+R_T to peak HBM — the same hazard _full_rebuild's
         # _pop_state dance avoids)
-        box = [delta_engine.embed_state(*self._pop_state())]
-        lb = jax.jit(delta_engine._live_bits)
+        box = [engines[0].embed_state(*self._pop_state())]
+        lb = jax.jit(engines[0]._live_bits)
         start_total = _host_bit_total(fetch_global(lb(*box[0])))
         iters = 0
-        rounds = 0
-        while True:
-            # init_total=0: derivation accounting happens once at the
-            # end under the full universe's live mask (the base engine
-            # would miss bit lanes past its own concept count anyway);
-            # termination uses the engines' RAW change signal
-            r = delta_engine.saturate(
+        streak = 0
+        ei = 0
+        # round-robin to the JOINT fixed point: stop once every engine
+        # in turn reports a quiet pass.  init_total=0: derivation
+        # accounting happens once at the end under the full universe's
+        # live mask; termination uses the engines' RAW change signal
+        # (``iterations > unroll`` ⇔ some vote derived something) — the
+        # base engine's derivation COUNT masks bit lanes past its own
+        # concept universe, so a counted zero could lie.
+        while streak < len(engines):
+            eng = engines[ei % len(engines)]
+            ei += 1
+            r = eng.saturate(
                 self.config.max_iterations, initial=box.pop(), init_total=0
             )
             iters += r.iterations
-            unproductive = r.iterations <= delta_engine.unroll
+            unproductive = r.iterations <= eng.unroll
             box.append((r.packed_s, r.packed_r))
             del r
-            if rounds and unproductive:
-                # the base pass before this derived into a state the
-                # delta rules had already closed: joint fixed point
-                break
-            r = base.saturate(
-                self.config.max_iterations, initial=box.pop(), init_total=0
-            )
-            iters += r.iterations
-            unproductive = r.iterations <= base.unroll
-            box.append((r.packed_s, r.packed_r))
-            del r
-            rounds += 1
-            if unproductive:
-                break  # base derived nothing beyond the delta's closure
+            streak = streak + 1 if unproductive else 0
         final_total = _host_bit_total(fetch_global(lb(*box[0])))
         return SaturationResult(
             packed_s=box[0][0],

@@ -143,6 +143,8 @@ class RowPackedSaturationEngine:
         l_chunk: Optional[int] = None,
         gate_chunks: Optional[bool] = None,
         min_links_pad: int = 0,
+        min_concepts: int = 0,
+        link_window: Optional[Tuple[int, int]] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -150,6 +152,13 @@ class RowPackedSaturationEngine:
         ``mm_opts``: extra keyword overrides for the CR4/CR6
         :class:`PackedColsMatmulPlan` (tiling, ``skip_zero_tiles``,
         ``interpret``) — the test hook for pinning a kernel variant.
+        ``link_window``: restrict the CR4/CR6 contractions to links in
+        ``[start, stop)`` — the incremental cross-term path runs the
+        full axiom tables against ONLY the delta's new links (the
+        one-sided halves of the reference's two-sided T3₂ increment
+        join, ``base/Type3_2AxiomProcessorBase.java:100-174``).  Row
+        rules (CR1-CR3) and CR5 are unaffected (CR5 re-deriving over
+        old links is idempotent).
         ``gate_chunks``: frontier-gated chunk skipping (None = auto:
         enabled from 32k concepts, where skipped work outweighs the
         per-chunk branch, up to the large-state threshold — past ~2.5 GB
@@ -169,8 +178,13 @@ class RowPackedSaturationEngine:
         self.n_shards = int(mesh.shape[word_axis]) if mesh is not None else 1
         pad_multiple = _pad_up(max(pad_multiple, 32), 32)
         # the packed word axis must divide evenly across shards
+        # min_concepts: a cooperating caller (the incremental path) can
+        # force concept-lane headroom beyond the corpus so later
+        # class-only deltas fit the compiled program's padding even when
+        # n_concepts lands exactly on a pad_multiple boundary
         self.nc = _pad_up(
-            _pad_up(max(idx.n_concepts, 2), pad_multiple), 32 * self.n_shards
+            _pad_up(max(idx.n_concepts, min_concepts, 2), pad_multiple),
+            32 * self.n_shards,
         )
         # min_links_pad: a cooperating engine (the incremental delta
         # fast path) can force this engine's link-row padding up to
@@ -205,7 +219,20 @@ class RowPackedSaturationEngine:
             unroll = 1 if state_bytes > (9 << 29) else 2
         self.unroll = max(int(unroll), 1)
         if temp_budget_bytes is None:
-            temp_budget_bytes = (1 << 28) if large else (1 << 29)
+            if state_bytes > (9 << 29):
+                # third tier: at ≥ ~5 GB state only a 64 MB chunk budget
+                # leaves room for the scheduler's concurrent chunk
+                # temporaries (measured at 128k many-role on a 16 GB
+                # v5e: 2^26 runs at 8.2 GB temp, 2^27+ OOMs)
+                temp_budget_bytes = 1 << 26
+            else:
+                temp_budget_bytes = (1 << 28) if large else (1 << 29)
+        # past the third tier, also pin the CR4/CR6 chunk order with
+        # optimization barriers: XLA otherwise overlaps independent
+        # chunks' contraction temporaries and the peak is both higher
+        # and run-to-run variable — 128k single-chip measured flaky at
+        # 8.2 GB temp without, stable with
+        self._serialize_chunks = state_bytes > (9 << 29)
         if gate_chunks is None and large:
             gate_chunks = False
         # int8 × int8 → int32 runs 2x bf16 on the MXU and is exact
@@ -260,10 +287,14 @@ class RowPackedSaturationEngine:
             use_pallas = jax.default_backend() == "tpu"
         self._use_pallas = use_pallas
         gather_rows = max(temp_budget_bytes // (self.wc * 4), 1)
+        # the XLA fallback materializes the unpacked [rk, 32·wl] i32
+        # product per SHARD-LOCAL word width (the Pallas kernel keeps
+        # everything packed, so there only the packed output counts)
+        wl_words = self.wc // self.n_shards
         mm_rows = (
             gather_rows
             if use_pallas
-            else max(temp_budget_bytes // 2 // (self.nc * 4), 1)
+            else max(temp_budget_bytes // 2 // (128 * wl_words), 1)
         )
 
         # ---- ROLE-AWARE row chunking for CR4/CR6.  The axiom tables
@@ -300,6 +331,14 @@ class RowPackedSaturationEngine:
             """[(raw_ids, inv, piece)] — ``raw_ids`` a contiguous
             role-sorted row range, ``piece`` a LOCAL seg-OR plan over
             the chunk's targets, ``inv`` its emission order."""
+
+            def materialize(spans):
+                out = []
+                for a0, a1 in spans:
+                    piece = SegmentedRowOr(tab_targets[a0:a1])
+                    out.append((np.arange(a0, a1), piece.order, piece))
+                return out
+
             n = len(tab_roles)
             if n == 0:
                 return []
@@ -310,14 +349,7 @@ class RowPackedSaturationEngine:
                 spans = [
                     (o, min(o + mm_rows, n)) for o in range(0, n, mm_rows)
                 ]
-                return [
-                    (
-                        np.arange(a0, a1),
-                        (p := SegmentedRowOr(tab_targets[a0:a1])).order,
-                        p,
-                    )
-                    for a0, a1 in spans
-                ]
+                return materialize(spans)
             starts = np.flatnonzero(
                 np.r_[True, tab_roles[1:] != tab_roles[:-1]]
             )
@@ -355,11 +387,7 @@ class RowPackedSaturationEngine:
                 spans = greedy(waste)
                 if len(spans) <= 48:
                     break
-            out = []
-            for a0, a1 in spans:
-                piece = SegmentedRowOr(tab_targets[a0:a1])
-                out.append((np.arange(a0, a1), piece.order, piece))
-            return out
+            return materialize(spans)
 
         self._cr4_chunks = (
             role_chunks(idx.nf4[:, 0], idx.nf4[:, 2]) if self._has4 else []
@@ -443,7 +471,14 @@ class RowPackedSaturationEngine:
         # link-table arrays at the final width
         h = idx.role_closure
         link_roles = idx.links[:, 0] if idx.n_links else np.zeros(0, np.int64)
-        fillers = np.zeros(self.nl, np.int64)
+        # padded link rows get filler ⊤, NOT 0 (= ⊥): with filler 0,
+        # CR5's ⊥-filler mask is true for padded rows (⊥ ∈ S(⊥)), and a
+        # cooperating program that parks NEW links in this engine's
+        # padding (the incremental cross-term path) would have their R
+        # bits OR-ed into the ⊥ row by THIS engine's stale CR5.  ⊥ ∈
+        # S(⊤) only when the whole ontology is inconsistent — where
+        # every concept is already unsatisfiable, so the OR is sound.
+        fillers = np.full(self.nl, TOP_ID, np.int64)
         if idx.n_links:
             fillers[: idx.n_links] = idx.links[:, 1]
         self._fillers = fillers
@@ -497,6 +532,9 @@ class RowPackedSaturationEngine:
                 croles = np.unique(role_of(raw))
                 rel = np.flatnonzero(h[:, croles].any(axis=1))
                 live = np.flatnonzero(np.isin(self._link_roles, rel))
+                if link_window is not None:
+                    w0, w1 = link_window
+                    live = live[(live >= w0) & (live < w1)]
                 if live.size == 0:
                     continue
                 offs = []
@@ -506,13 +544,11 @@ class RowPackedSaturationEngine:
                     offs.append(off)
                     i = int(np.searchsorted(live, off + lcn))
                 offs = np.asarray(offs, np.int32)
-                fill_t = np.stack(
-                    [self._fillers[o : o + lcn] for o in offs]
-                ).astype(np.int32)
-                lrole_t = np.stack(
-                    [self._link_roles[o : o + lcn] for o in offs]
-                )
-                # aligned dirty_l chunks a window overlaps (≤ 2)
+                # aligned dirty_l chunks a window overlaps (≤ 2); the
+                # filler/link-role window contents are dynamic slices of
+                # the SHARED [nl] tables at runtime — stacking copies
+                # here would replicate them up to n_chunks times in the
+                # jitted-run arguments
                 c01 = np.stack(
                     [
                         offs // lcn,
@@ -523,14 +559,7 @@ class RowPackedSaturationEngine:
                     axis=1,
                 ).astype(np.int32)
                 kept.append((raw, inv, piece))
-                tiles.append(
-                    (
-                        jnp.asarray(offs),
-                        jnp.asarray(fill_t),
-                        jnp.asarray(lrole_t),
-                        jnp.asarray(c01),
-                    )
-                )
+                tiles.append((jnp.asarray(offs), jnp.asarray(c01)))
             return kept, tiles
 
         self._cr4_chunks, self._cr4_tiles = build_tiles(
@@ -546,6 +575,8 @@ class RowPackedSaturationEngine:
         self._masks = (
             jnp.asarray(m4),
             jnp.asarray(m6),
+            jnp.asarray(self._fillers.astype(np.int32)),
+            jnp.asarray(self._link_roles),
             tuple(self._cr4_tiles),
             tuple(self._cr6_tiles),
         )
@@ -1007,7 +1038,7 @@ class RowPackedSaturationEngine:
         self,
         sp: jax.Array,
         rp: jax.Array,
-        masks: Optional[Tuple[jax.Array, jax.Array]] = None,
+        masks: Optional[tuple] = None,  # the self._masks plan-table pytree
         axis_name: Optional[str] = None,
         dirty: Optional[jax.Array] = None,
     ):
@@ -1023,7 +1054,9 @@ class RowPackedSaturationEngine:
         whole-array post-comparison, so the pre-step state is dead as
         soon as the last rule reads it — without this the fixed-point
         loop carries two full copies of S and OOMs ~2x earlier."""
-        m4, m6, t4, t6 = self._masks if masks is None else masks
+        m4, m6, fills, lroles, t4, t6 = (
+            self._masks if masks is None else masks
+        )
         gating = self._gate is not None
         if dirty is None:  # stateless public step(): all-dirty
             dirty = self.initial_dirty()
@@ -1164,18 +1197,23 @@ class RowPackedSaturationEngine:
             (see ``build_tiles`` in ``__init__``): the loop contracts
             only windows whose link roles can satisfy the chunk's
             axiom roles."""
-            offs, fill_t, lrole_t, c01 = tiles
+            offs, c01 = tiles
             n_t = int(offs.shape[0])
             rk = len(rows)
             subt = bits_state[jnp.asarray(rows)].T        # [W, rk], hoisted
 
             def one(i, acc):
+                # window contents slice the SHARED filler/link-role
+                # tables (stacked per-chunk copies would replicate them
+                # ×n_chunks in the run arguments)
+                fcols = lax.dynamic_slice(fills, (offs[i],), (lc,))
+                lrole = lax.dynamic_slice(lroles, (offs[i],), (lc,))
                 if axis_name is None:
-                    f = bit_lookup_from(subt, fill_t[i], dtype=dt)
+                    f = bit_lookup_from(subt, fcols, dtype=dt)
                 else:
                     f = lax.psum(
                         bit_lookup_from(
-                            subt, fill_t[i],
+                            subt, fcols,
                             word_offset=base, dtype=jnp.int32,
                         ),
                         axis_name,
@@ -1185,7 +1223,7 @@ class RowPackedSaturationEngine:
                 ).astype(dt)
                 # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
                 w = (
-                    jnp.take(mask_rows, lrole_t[i], axis=1).astype(dt)
+                    jnp.take(mask_rows, lrole, axis=1).astype(dt)
                     * f.T
                     * live
                 )
@@ -1224,6 +1262,8 @@ class RowPackedSaturationEngine:
                 sp, cv = plan.write(sp, red, track="rows")
                 s_vecs.append(cv)
                 ch |= jnp.any(cv)
+                if self._serialize_chunks:
+                    sp, rp = lax.optimization_barrier((sp, rp))
         # CR6: role chains
         if self._has6:
             for k, ((raw, inv, plan), mm) in enumerate(
@@ -1248,6 +1288,8 @@ class RowPackedSaturationEngine:
                 rp, cv = plan.write(rp, red, track="rows")
                 r_vecs.append(cv)
                 ch |= jnp.any(cv)
+                if self._serialize_chunks:
+                    sp, rp = lax.optimization_barrier((sp, rp))
         # CR5: ⊥ back-propagation — one masked packed OR-reduce (its
         # gate flag is always the LAST one, after the CR4/CR6 chunks)
         if self._bottom:

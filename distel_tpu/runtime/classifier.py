@@ -57,12 +57,18 @@ class ClassificationResult:
         }
 
 
-def make_engine(config: ClassifierConfig, idx: IndexedOntology, mesh=None):
+def make_engine(
+    config: ClassifierConfig, idx: IndexedOntology, mesh=None, **rowpacked_kw
+):
     """Engine selection: the row-packed transposed engine is the flagship
     (fastest measured on TPU and 8x the dense concept ceiling); "dense"
     and "packed" remain the reference paths.  ``rule_backends`` entries
     routing rules off-device wrap the row-packed engine in the hybrid
-    saturator (the reference's rule→node plugin boundary)."""
+    saturator (the reference's rule→node plugin boundary).
+    ``rowpacked_kw``: extra row-packed engine kwargs (``min_concepts``,
+    ``min_links_pad`` — the incremental path's padding reservations);
+    ignored by the reference engines, which the incremental fast path
+    never reuses anyway."""
     choice = "rowpacked" if config.engine == "auto" else config.engine
     if choice not in ("rowpacked", "packed", "dense"):
         raise ValueError(
@@ -90,7 +96,7 @@ def make_engine(config: ClassifierConfig, idx: IndexedOntology, mesh=None):
     if choice == "rowpacked":
         from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
 
-        return RowPackedSaturationEngine(idx, **kw)
+        return RowPackedSaturationEngine(idx, **kw, **rowpacked_kw)
     if choice == "packed":
         from distel_tpu.core.packed_engine import PackedSaturationEngine
 

@@ -853,3 +853,114 @@ def test_incremental_fast_path_nf4_sorts_into_prefix():
         if i < batch.idx.n_concepts
     }
     assert sups == bsups
+
+
+def _inc_vs_batch(base_text, delta_text, probes, expect_fast=True):
+    """Drive base+delta through the incremental fast path and compare
+    every probed concept's subsumer set against a cold batch run.
+    Returns the incremental subsumer map keyed by probe name."""
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    inc.add_text(base_text)
+    base_engine = inc._base_engine
+    r = inc.add_text(delta_text)
+    if expect_fast:
+        assert inc._base_engine is base_engine, "expected the fast path"
+    batch = RowPackedSaturationEngine(
+        index_ontology(normalize(parser.parse(base_text + delta_text)))
+    ).saturate()
+    out = {}
+    for name in probes:
+        got = {
+            r.idx.concept_names[i]
+            for i in r.subsumers(r.idx.concept_ids[name])
+            if i < r.idx.n_concepts
+        }
+        want = {
+            batch.idx.concept_names[i]
+            for i in batch.subsumers(batch.idx.concept_ids[name])
+            if i < batch.idx.n_concepts
+        }
+        assert got == want, (name, got ^ want)
+        out[name] = got
+    return out
+
+
+def test_incremental_link_delta_cross_term_old_axiom_new_link():
+    """The (old axioms × new links) half of the T3₂ increment join: the
+    base holds an ∃-on-the-left axiom whose restriction no base link
+    satisfies; the delta adds the link (same role, fresh filler pair).
+    Only the CROSS program contracts the old axiom against the new
+    link — dropping it would silently miss Someone ⊑ Target."""
+    base = (
+        "SubClassOf(ObjectSomeValuesFrom(r OldFiller) Target)\n"
+        "SubClassOf(Target TargetSup)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(r PadFiller))\n"  # r has a link
+        "SubClassOf(OldFiller OldFillerSup)\n"
+    )
+    delta = "SubClassOf(Someone ObjectSomeValuesFrom(r OldFiller))\n"
+    sups = _inc_vs_batch(base, delta, ["Someone", "Pad"])
+    assert {"Target", "TargetSup"} <= sups["Someone"]
+
+
+def test_incremental_link_delta_new_axiom_and_chain_growth():
+    """A link-creating delta whose new link feeds an old CHAIN (the
+    indexer derives new chain links + chain_pairs at re-index): the
+    cross program must join the grown chain table against the new-link
+    window, and the delta program the new chain pairs against all."""
+    base = (
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) t)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(t D) ChainHit)\n"
+        "SubClassOf(B BSup)\n"
+    )
+    # new link (s, D) on the old filler B's row: A -r-> B -s-> D gives
+    # A -t-> D, so A ⊑ ChainHit only via the new link
+    delta = "SubClassOf(B ObjectSomeValuesFrom(s D))\n"
+    sups = _inc_vs_batch(base, delta, ["A", "B"])
+    assert "ChainHit" in sups["A"]
+
+
+def test_incremental_link_delta_cr5_over_new_link():
+    """⊥ must propagate over a NEW link: the base program's stale
+    filler table cannot see it (⊤-sentinel padding), so the delta
+    program's CR5 carries the sweep."""
+    base = (
+        "DisjointClasses(D1 D2)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(r PadFiller))\n"
+        "SubClassOf(D1 D1Sup)\n"
+    )
+    delta = (
+        "SubClassOf(NewX ObjectSomeValuesFrom(r BadFiller))\n"
+        "SubClassOf(BadFiller D1)\nSubClassOf(BadFiller D2)\n"
+    )
+    sups = _inc_vs_batch(base, delta, ["NewX", "BadFiller"])
+    assert "owl:Nothing" in sups["NewX"]
+    assert "owl:Nothing" in sups["BadFiller"]
+
+
+def test_incremental_link_delta_overflowing_pad_rebuilds():
+    """More new links than the reserved rows: fall back to rebuild and
+    still match the batch closure."""
+    base = "SubClassOf(Pad ObjectSomeValuesFrom(r PadFiller))\n"
+    delta = "\n".join(
+        f"SubClassOf(L{i} ObjectSomeValuesFrom(r F{i}))" for i in range(40)
+    )
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    inc._LINK_PAD = 0  # no reservation: link deltas must rebuild
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    r = inc.add_text(delta)
+    assert inc._base_engine is not base_engine, "expected a rebuild"
+    names = {
+        r.idx.concept_names[i]
+        for i in r.subsumers(r.idx.concept_ids["L7"])
+        if i < r.idx.n_concepts
+    }
+    assert "L7" in names
