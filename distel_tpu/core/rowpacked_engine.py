@@ -1100,21 +1100,26 @@ class RowPackedSaturationEngine:
         if self._p1.k or self._p2.k or self._p3.k:
 
             def block_rules(sb, rb):
+                # named_scope: phase attribution for the step profiler
+                # (runtime/profiling.py reads scopes out of hlo_stats)
                 cvs = []
                 if self._p1.k:  # CR1: a ⊑ b
-                    red = self._p1.reduce(sb[jnp.asarray(self._src1)])
-                    sb, cv = self._p1.write(sb, red, track="rows")
+                    with jax.named_scope("cr1"):
+                        red = self._p1.reduce(sb[jnp.asarray(self._src1)])
+                        sb, cv = self._p1.write(sb, red, track="rows")
                     cvs.append(cv)
                 if self._p2.k:  # CR2: a1 ⊓ a2 ⊑ b
-                    red = self._p2.reduce(
-                        sb[jnp.asarray(self._src2a)]
-                        & sb[jnp.asarray(self._src2b)]
-                    )
-                    sb, cv = self._p2.write(sb, red, track="rows")
+                    with jax.named_scope("cr2"):
+                        red = self._p2.reduce(
+                            sb[jnp.asarray(self._src2a)]
+                            & sb[jnp.asarray(self._src2b)]
+                        )
+                        sb, cv = self._p2.write(sb, red, track="rows")
                     cvs.append(cv)
                 if self._p3.k:  # CR3: a ⊑ ∃link — reads S, writes R
-                    red = self._p3.reduce(sb[jnp.asarray(self._src3)])
-                    rb, cv = self._p3.write(rb, red, track="rows")
+                    with jax.named_scope("cr3"):
+                        red = self._p3.reduce(sb[jnp.asarray(self._src3)])
+                        rb, cv = self._p3.write(rb, red, track="rows")
                     cvs.append(cv)
                 return sb, rb, cvs
 
@@ -1208,16 +1213,17 @@ class RowPackedSaturationEngine:
                 # ×n_chunks in the run arguments)
                 fcols = lax.dynamic_slice(fills, (offs[i],), (lc,))
                 lrole = lax.dynamic_slice(lroles, (offs[i],), (lc,))
-                if axis_name is None:
-                    f = bit_lookup_from(subt, fcols, dtype=dt)
-                else:
-                    f = lax.psum(
-                        bit_lookup_from(
-                            subt, fcols,
-                            word_offset=base, dtype=jnp.int32,
-                        ),
-                        axis_name,
-                    ).astype(dt)                          # [lc, rk]
+                with jax.named_scope("bit_table"):
+                    if axis_name is None:
+                        f = bit_lookup_from(subt, fcols, dtype=dt)
+                    else:
+                        f = lax.psum(
+                            bit_lookup_from(
+                                subt, fcols,
+                                word_offset=base, dtype=jnp.int32,
+                            ),
+                            axis_name,
+                        ).astype(dt)                      # [lc, rk]
                 live = (
                     dirty_l[c01[i, 0]] | dirty_l[c01[i, 1]] | f_dirty
                 ).astype(dt)
@@ -1258,8 +1264,9 @@ class RowPackedSaturationEngine:
                     )
                     return plan.reduce(out[inv])
 
-                red = gated_rows(plan.n_targets, (sp, rp), red4)
-                sp, cv = plan.write(sp, red, track="rows")
+                with jax.named_scope("cr4"):
+                    red = gated_rows(plan.n_targets, (sp, rp), red4)
+                    sp, cv = plan.write(sp, red, track="rows")
                 s_vecs.append(cv)
                 ch |= jnp.any(cv)
                 if self._serialize_chunks:
@@ -1284,8 +1291,9 @@ class RowPackedSaturationEngine:
                     )
                     return plan.reduce(out[inv])
 
-                red = gated_rows(plan.n_targets, rp, red6)
-                rp, cv = plan.write(rp, red, track="rows")
+                with jax.named_scope("cr6"):
+                    red = gated_rows(plan.n_targets, rp, red6)
+                    rp, cv = plan.write(rp, red, track="rows")
                 r_vecs.append(cv)
                 ch |= jnp.any(cv)
                 if self._serialize_chunks:
@@ -1305,24 +1313,28 @@ class RowPackedSaturationEngine:
                     masked, np.uint32(0), lax.bitwise_or, (0,)
                 )[None]
 
-            red = gated_rows(1, (sp, rp), red5)
-            old5 = sp[BOTTOM_ID]
-            merged5 = old5 | red[0]
-            sp = sp.at[BOTTOM_ID].set(merged5)
-            cv = jnp.any(merged5 != old5)[None]
+            with jax.named_scope("cr5"):
+                red = gated_rows(1, (sp, rp), red5)
+                old5 = sp[BOTTOM_ID]
+                merged5 = old5 | red[0]
+                sp = sp.at[BOTTOM_ID].set(merged5)
+                cv = jnp.any(merged5 != old5)[None]
             s_vecs.append(cv)
             ch |= jnp.any(cv)
-        mask_s, any_r, dirty_l_next = self._next_frontier(s_vecs, r_vecs)
-        gate_next = (
-            self._next_dirty(mask_s, any_r, axis_name)
-            if gating
-            else gate_flags
-        )
-        if axis_name is not None:
-            dirty_l_next = (
-                lax.psum(dirty_l_next.astype(jnp.int32), axis_name) > 0
+        with jax.named_scope("frontier"):
+            mask_s, any_r, dirty_l_next = self._next_frontier(
+                s_vecs, r_vecs
             )
-            mask_s = lax.psum(mask_s.astype(jnp.int32), axis_name) > 0
+            gate_next = (
+                self._next_dirty(mask_s, any_r, axis_name)
+                if gating
+                else gate_flags
+            )
+            if axis_name is not None:
+                dirty_l_next = (
+                    lax.psum(dirty_l_next.astype(jnp.int32), axis_name) > 0
+                )
+                mask_s = lax.psum(mask_s.astype(jnp.int32), axis_name) > 0
         return sp, rp, ch, (gate_next, dirty_l_next, mask_s)
 
     def step(self, sp, rp):

@@ -177,3 +177,142 @@ def parse(text: str) -> S.Ontology:
 def parse_file(path: str) -> S.Ontology:
     with open(path, "r", encoding="utf-8") as f:
         return parse(f.read())
+
+
+# ---------------------------------------------------------------- writer
+
+class _Writer:
+    """AST → OWL/XML elements, the exact inverse vocabulary of
+    :class:`_Reader` (so any corpus this framework can hold round-trips
+    through the ``.owx`` serialization — the conversion path used to
+    validate the reader against REAL published RDF/XML corpora, r2
+    verdict item 8)."""
+
+    def __init__(self) -> None:
+        self.individuals: set = set()
+
+    def _e(self, tag: str, *children: ET.Element, **attrs) -> ET.Element:
+        el = ET.Element(tag)
+        for k, v in attrs.items():
+            el.set(k, v)
+        el.extend(children)
+        return el
+
+    def expr(self, e: S.ClassExpression) -> ET.Element:
+        if isinstance(e, S.Individual):
+            # nominal-as-expression: Class element + NamedIndividual
+            # declaration (how the reader re-discovers individual-ness)
+            self.individuals.add(e.iri)
+            return self._e("Class", IRI=e.iri)
+        if isinstance(e, S.Class):
+            return self._e("Class", IRI=e.iri)
+        if isinstance(e, S.ObjectIntersectionOf):
+            return self._e(
+                "ObjectIntersectionOf", *(self.expr(o) for o in e.operands)
+            )
+        if isinstance(e, S.ObjectSomeValuesFrom):
+            return self._e(
+                "ObjectSomeValuesFrom",
+                self._e("ObjectProperty", IRI=e.role.iri),
+                self.expr(e.filler),
+            )
+        if isinstance(e, S.ObjectOneOf):
+            for i in e.individuals:
+                self.individuals.add(i.iri)
+            return self._e(
+                "ObjectOneOf",
+                *(
+                    self._e("NamedIndividual", IRI=i.iri)
+                    for i in e.individuals
+                ),
+            )
+        if isinstance(e, S.UnsupportedClassExpression):
+            # placeholder element: the reader maps any unknown tag back
+            # to UnsupportedClassExpression(tag), so drop-and-record
+            # accounting survives the round trip
+            return self._e(e.constructor)
+        raise TypeError(f"cannot serialize {e!r}")
+
+    def _role(self, r: S.ObjectProperty) -> ET.Element:
+        return self._e("ObjectProperty", IRI=r.iri)
+
+    def axiom(self, ax: S.Axiom) -> ET.Element:
+        if isinstance(ax, S.SubClassOf):
+            return self._e("SubClassOf", self.expr(ax.sub), self.expr(ax.sup))
+        if isinstance(ax, S.EquivalentClasses):
+            return self._e(
+                "EquivalentClasses", *(self.expr(o) for o in ax.operands)
+            )
+        if isinstance(ax, S.DisjointClasses):
+            return self._e(
+                "DisjointClasses", *(self.expr(o) for o in ax.operands)
+            )
+        if isinstance(ax, S.SubObjectPropertyOf):
+            if len(ax.chain) == 1:
+                sub = self._role(ax.chain[0])
+            else:
+                sub = self._e(
+                    "ObjectPropertyChain", *(self._role(r) for r in ax.chain)
+                )
+            return self._e("SubObjectPropertyOf", sub, self._role(ax.sup))
+        if isinstance(ax, S.EquivalentObjectProperties):
+            return self._e(
+                "EquivalentObjectProperties",
+                *(self._role(r) for r in ax.operands),
+            )
+        if isinstance(ax, S.TransitiveObjectProperty):
+            return self._e("TransitiveObjectProperty", self._role(ax.role))
+        if isinstance(ax, S.ReflexiveObjectProperty):
+            return self._e("ReflexiveObjectProperty", self._role(ax.role))
+        if isinstance(ax, S.ObjectPropertyDomain):
+            return self._e(
+                "ObjectPropertyDomain", self._role(ax.role),
+                self.expr(ax.domain),
+            )
+        if isinstance(ax, S.ObjectPropertyRange):
+            return self._e(
+                "ObjectPropertyRange", self._role(ax.role),
+                self.expr(ax.range),
+            )
+        if isinstance(ax, S.ClassAssertion):
+            self.individuals.add(ax.individual.iri)
+            return self._e(
+                "ClassAssertion", self.expr(ax.cls),
+                self._e("NamedIndividual", IRI=ax.individual.iri),
+            )
+        if isinstance(ax, S.ObjectPropertyAssertion):
+            self.individuals.add(ax.subject.iri)
+            self.individuals.add(ax.object.iri)
+            return self._e(
+                "ObjectPropertyAssertion", self._role(ax.role),
+                self._e("NamedIndividual", IRI=ax.subject.iri),
+                self._e("NamedIndividual", IRI=ax.object.iri),
+            )
+        if isinstance(ax, S.UnsupportedAxiom):
+            return self._e(ax.kind)
+        raise TypeError(f"cannot serialize {ax!r}")
+
+
+def ontology_to_str(onto: S.Ontology) -> str:
+    """Serialize to OWL/XML (``.owx``), readable back by :func:`parse`."""
+    w = _Writer()
+    body = [w.axiom(ax) for ax in onto.axioms]
+    root = ET.Element("Ontology")
+    root.set("xmlns", OWLX)
+    root.set("ontologyIRI", onto.iri or "http://distel-tpu/generated")
+    for pfx, iri in sorted(onto.prefixes.items()):
+        root.append(
+            w._e("Prefix", name=pfx.rstrip(":"), IRI=iri)
+        )
+    for iri in sorted(w.individuals):
+        root.append(
+            w._e("Declaration", w._e("NamedIndividual", IRI=iri))
+        )
+    root.extend(body)
+    ET.indent(root)
+    return ET.tostring(root, encoding="unicode", xml_declaration=True)
+
+
+def write_file(onto: S.Ontology, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(ontology_to_str(onto))

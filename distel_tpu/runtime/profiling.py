@@ -1,0 +1,118 @@
+"""Step profiling: where does a superstep's device time actually go?
+
+The reference stamps nanoTime around every processor phase (init,
+key-read, applyRule, chunk waits — ``base/Type1_1AxiomProcessorBase.java:
+183-214``) and prints the split.  Here the whole fixed point is ONE fused
+XLA program, so host timers can't see inside it; instead the engine's
+``_step`` wraps each rule family in ``jax.named_scope`` and this module
+captures a ``jax.profiler`` device trace around a full ``saturate()``
+call, then aggregates per-op self-times by scope out of the profiler's
+``hlo_stats`` table (the scope survives fusion as the root op's
+framework-op path).
+
+Caveat, stated where the number is made: XLA fuses ACROSS scope
+boundaries, so an op that merged two phases is attributed to its root
+op's phase — the split is faithful at the granularity XLA actually
+executes, not a promise that the phases ran separately.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import tempfile
+import time
+from typing import Optional
+
+#: innermost-wins order: bit_table nests inside cr4/cr6
+_PHASE_TOKENS = (
+    "bit_table", "cr1", "cr2", "cr3", "cr4", "cr5", "cr6", "frontier",
+)
+
+
+def _phase_of(tf_op_name: str, category: str) -> str:
+    parts = tf_op_name.split("/")
+    for tok in _PHASE_TOKENS:
+        if tok in parts:
+            return "bit_table_psum" if tok == "bit_table" else tok
+    if "all-reduce" in category:
+        return "vote_psum"  # the convergence vote / un-scoped exchange
+    return "other"
+
+
+def hlo_phase_split(xplane_paths) -> dict:
+    """Aggregate an xplane capture's per-op device self-times (µs) into
+    named-scope phases.  Returns ``{phase: seconds}``."""
+    from xprof.convert import raw_to_tool_data  # heavy import, lazy
+
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        list(xplane_paths), "hlo_stats", {}
+    )
+    table = json.loads(data if isinstance(data, str) else data.decode())
+    cols = [c["id"] for c in table["cols"]]
+    i_cat = cols.index("category")
+    i_name = cols.index("tf_op_name")
+    i_self = cols.index("total_self_time")
+    phases: dict = {}
+    for row in table["rows"]:
+        c = row["c"]
+        cat = (c[i_cat]["v"] or "").lower()
+        name = c[i_name]["v"] or ""
+        us = float(c[i_self]["v"] or 0.0)
+        phase = _phase_of(name, cat)
+        phases[phase] = phases.get(phase, 0.0) + us * 1e-6
+    return phases
+
+
+def profile_saturation(
+    engine,
+    *,
+    initial=None,
+    trace_dir: Optional[str] = None,
+    max_iters: int = 10_000,
+) -> dict:
+    """Trace one full ``saturate()`` and return the per-phase split.
+
+    Output fields: ``phases`` (seconds of device self-time per phase over
+    the WHOLE run), ``per_step`` (same, divided by supersteps),
+    ``device_total_s``, ``wall_s``, ``iterations``; per-step parts sum to
+    ``device_total_s / iterations`` ≤ wall/iterations (the gap is host
+    orchestration + tunnel latency, reported as ``host_gap_s``)."""
+    import jax
+
+    import xprof.convert  # fail BEFORE paying a full traced run  # noqa: F401
+
+    own = trace_dir is None
+    if own:
+        trace_dir = tempfile.mkdtemp(prefix="distel_profile_")
+    try:
+        jax.profiler.start_trace(trace_dir)
+        t0 = time.time()
+        try:
+            result = engine.saturate(max_iters, initial=initial)
+            wall = time.time() - t0
+        finally:
+            jax.profiler.stop_trace()
+        xplanes = glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+        )
+        phases = hlo_phase_split(xplanes)
+    finally:
+        if own:
+            import shutil
+
+            shutil.rmtree(trace_dir, ignore_errors=True)
+    steps = max(result.iterations, 1)
+    device_total = sum(phases.values())
+    return {
+        "phases_s": {k: round(v, 4) for k, v in sorted(phases.items())},
+        "per_step_s": {
+            k: round(v / steps, 5) for k, v in sorted(phases.items())
+        },
+        "device_total_s": round(device_total, 3),
+        "wall_s": round(wall, 3),
+        "host_gap_s": round(wall - device_total, 3),
+        "iterations": int(result.iterations),
+        "derivations": int(result.derivations),
+    }

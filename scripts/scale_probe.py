@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""SNOMED-scale sharded probe: the SCALE_r0N.json producer.
+
+Round 2 recorded only compile-time ``memory_analysis`` at >=128k classes;
+this script EXECUTES the word-axis-sharded fixed point on the virtual
+8-device CPU mesh to completion and verifies the result two ways:
+
+* derivation-count identity against a single-device run of the same
+  corpus (the engines are bit-identical across meshes by construction,
+  so a mismatch means a sharding bug, not noise);
+* sound-containment against a time-budgeted partial oracle: EL+
+  saturation is monotone, so every fact the partial (sound, incomplete)
+  CPU oracle derives MUST be present in the engine closure — a
+  ground-truth check that works at sizes where no oracle converges
+  (reference analog: the ELK diff of ``test/ELClassifierTest.java:363-446``
+  applied as a one-sided bound).
+
+Usage:
+  python scripts/scale_probe.py N_CLASSES --devices 8 [--execute]
+      [--oracle-budget 300] [--sample 2000] [--out FILE]
+  python scripts/scale_probe.py N_CLASSES --devices 0 [--execute]  # real chip
+
+``--devices K`` (K>0) re-execs itself in a subprocess pinned to a
+K-device virtual CPU mesh (the recipe shared with tests/conftest.py and
+__graft_entry__.dryrun_multichip); ``--devices 0`` runs single-device on
+whatever backend the environment attaches (the real chip under axon).
+Prints one JSON line; ``--out`` appends it to a file as well.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n_classes", type=int)
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh size; 0 = single-device on the "
+                         "default backend (the real chip)")
+    ap.add_argument("--execute", action="store_true",
+                    help="run the fixed point to convergence (not just "
+                         "AOT-compile + memory analysis)")
+    ap.add_argument("--oracle-budget", type=float, default=0.0,
+                    help="seconds of partial-oracle saturation to check "
+                         "sound containment against (0 = skip)")
+    ap.add_argument("--sample", type=int, default=2000,
+                    help="concepts sampled for the containment check")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    return ap.parse_args(argv)
+
+
+def main() -> None:
+    args = _parse_args()
+    if args.devices > 0 and not args.child:
+        from distel_tpu.testing.cpumesh import cpu_mesh_env, cpu_mesh_ready
+
+        if not cpu_mesh_ready(args.devices):
+            # env must be set before the child interpreter starts
+            # (sitecustomize keys tunnel registration on PALLAS_AXON_POOL_IPS)
+            env = cpu_mesh_env(args.devices)
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)]
+                + sys.argv[1:] + ["--child"],
+                env=env, cwd=_REPO,
+            ).returncode
+            sys.exit(rc)
+    if args.child:
+        from distel_tpu.testing.cpumesh import force_cpu_mesh
+
+        force_cpu_mesh(args.devices)
+    run_probe(args)
+
+
+def run_probe(args) -> None:
+    import jax
+    import numpy as np
+
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+    from distel_tpu.owl import parser
+
+    rec = {
+        "n_classes": args.n_classes,
+        "devices": args.devices or 1,
+        "backend": jax.default_backend(),
+    }
+    t0 = time.time()
+    text = snomed_shaped_ontology(n_classes=args.n_classes)
+    norm = normalize(parser.parse(text))
+    idx = index_ontology(norm)
+    rec["index_s"] = round(time.time() - t0, 1)
+    rec["n_concepts"] = idx.n_concepts
+    rec["n_links"] = idx.n_links
+
+    mesh = None
+    if args.devices > 0:
+        devices = np.array(jax.devices()[: args.devices])
+        mesh = jax.sharding.Mesh(devices, ("c",))
+    t0 = time.time()
+    engine = RowPackedSaturationEngine(idx, mesh=mesh)
+    rec["build_s"] = round(time.time() - t0, 1)
+
+    # ---- AOT: compile the full fixed-point program, read its memory
+    # analysis (what round 2's probe recorded; kept for trend comparison)
+    budget = 10_000 - 10_000 % engine.unroll
+    sp0, rp0 = engine.initial_state()
+    t0 = time.time()
+    if mesh is None:
+        lowered = engine._run_jit.lower(sp0, rp0, engine._masks, budget)
+    else:
+        lowered = engine._run_jit(budget).lower(sp0, rp0, engine._masks)
+    compiled = lowered.compile()
+    rec["step_compile_s"] = round(time.time() - t0, 1)
+    try:
+        ma = compiled.memory_analysis()
+        n_sh = max(engine.n_shards, 1)
+        gb = 1 / (1 << 30)
+        state_b = (engine.nc + engine.nl) * engine.wc * 4 / n_sh
+        rec["per_shard_state_gb"] = round(state_b * gb, 3)
+        rec["per_shard_temp_gb"] = round(ma.temp_size_in_bytes * gb, 2)
+        rec["per_shard_args_gb"] = round(ma.argument_size_in_bytes * gb, 2)
+        rec["per_shard_out_gb"] = round(ma.output_size_in_bytes * gb, 2)
+        rec["per_shard_total_live_gb"] = round(
+            (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+             + ma.output_size_in_bytes) * gb, 2)
+    except Exception as e:  # backend without memory_analysis
+        rec["memory_analysis_error"] = str(e)
+
+    if args.execute:
+        del compiled, lowered
+        t0 = time.time()
+        result = engine.saturate()
+        rec["exec_wall_s"] = round(time.time() - t0, 1)
+        rec["iterations"] = int(result.iterations)
+        rec["derivations"] = int(result.derivations)
+        rec["converged"] = bool(result.converged)
+
+        if args.oracle_budget > 0:
+            from distel_tpu.core import oracle as cpu_oracle
+            from distel_tpu.core.indexing import atom_key
+
+            t0 = time.time()
+            partial = cpu_oracle.saturate(
+                norm, time_budget_s=args.oracle_budget
+            )
+            rec["oracle_budget_s"] = args.oracle_budget
+            rec["oracle_partial_facts"] = partial.derivation_count()
+            rec["oracle_converged"] = bool(partial.converged)
+            # sound containment on a concept sample: every subsumer the
+            # partial oracle derived must be in the engine closure.  Read
+            # the PACKED transposed closure directly (S_T[a, xw]: bit x of
+            # word xw set iff a ∈ S(x)) — S(x) is one packed column slice;
+            # the unpacked .s view would materialize an Nc² bool matrix
+            # (~33 GB at 128k classes).
+            from distel_tpu.core.engine import fetch_global
+
+            ps = np.asarray(fetch_global(result.packed_s))
+            rng = np.random.default_rng(0)
+            atoms = sorted(partial.subsumers, key=atom_key)
+            pick = rng.choice(
+                len(atoms), size=min(args.sample, len(atoms)), replace=False
+            )
+            missing = checked = 0
+            for i in pick:
+                atom = atoms[i]
+                cid = idx.concept_ids.get(atom_key(atom))
+                if cid is None:
+                    continue
+                col = (ps[:, cid >> 5] >> np.uint32(cid & 31)) & 1
+                eng = {
+                    idx.concept_names[j]
+                    for j in np.nonzero(col)[0]
+                    if j < idx.n_concepts
+                }
+                for sup in partial.subsumers[atom]:
+                    checked += 1
+                    if atom_key(sup) not in eng:
+                        missing += 1
+            rec["containment_checked_facts"] = checked
+            rec["containment_missing"] = missing
+            rec["containment_check_s"] = round(time.time() - t0, 1)
+            if missing:
+                rec["containment_ok"] = False
+                print(json.dumps(rec))
+                raise SystemExit(
+                    f"UNSOUND: engine closure missing {missing} "
+                    f"oracle-derived facts"
+                )
+            rec["containment_ok"] = True
+
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
