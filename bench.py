@@ -221,7 +221,7 @@ def main() -> None:
 
         inc2 = IncrementalClassifier()
         inc2.add_text(snomed_shaped_ontology(n_classes=_INC_BASE_CLASSES))
-        inc2._base_engine = inc2._base_idx = None  # force the rebuild path
+        inc2.drop_base_program()  # force the rebuild path
         t0 = time.time()
         inc2.add_text(delta)
         extra["incremental_delta_rebuild_s"] = round(time.time() - t0, 2)

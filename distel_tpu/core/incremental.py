@@ -98,6 +98,13 @@ class IncrementalClassifier:
     def add_text(self, text: str) -> SaturationResult:
         return self.add_ontology(owl_loader.load(text))
 
+    def drop_base_program(self) -> None:
+        """Forget the compiled base program so the NEXT delta takes the
+        full-rebuild path — the loud, supported way to time or compare
+        the rebuild (bench.py's fast-vs-rebuild figures) instead of
+        poking private attributes."""
+        self._base_engine = self._base_idx = None
+
     def _pop_state(self):
         state, self._state = self._state, None
         return state

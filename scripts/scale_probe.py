@@ -84,6 +84,10 @@ def run_probe(args) -> None:
     import jax
     import numpy as np
 
+    from distel_tpu.config import enable_compile_cache
+
+    enable_compile_cache()
+
     from distel_tpu.core.indexing import index_ontology
     from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
     from distel_tpu.frontend.normalizer import normalize

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Weak-scaling sweep on multiplied REAL data (BASELINE.md config 5).
+
+The reference's scale evaluation duplicates a corpus n times
+(``samples/OntologyMultiplier.java:32-88``) and classifies the union,
+looping sizes via ``scripts/run-all.sh:12-39`` up to ~10M axioms over a
+32-node Redis cluster.  This driver reproduces that regime on ONE chip:
+
+* plain n-copy duplication of the vendored real GALEN module
+  (``tests/corpora/galen_module_jia.owl``, extracted from the
+  reference's own SyGENiA.jar) — ingested through the native C++ load
+  plane, partitioned into interaction components
+  (``core/components.py``), and saturated as vmapped batches of
+  isomorphic copies: per-copy state is LINEAR in copies, so 10M axioms
+  fit where the dense quadratic union could not.
+* ``--crossed`` duplication (the reference's A1⊓B2⊑C1 cross-copy
+  pattern) chains the copies into ONE component — the dense-engine
+  control, swept to the single-chip ceiling.
+
+Each size prints one JSON line with ingest/partition/solve walls,
+derivations, and derivations/s.
+
+Usage:
+  python scripts/weak_scaling.py [--copies 64,512,4096,16384,65536]
+      [--crossed-copies 16,64,256] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+GALEN = os.path.join(_REPO, "tests", "corpora", "galen_module_jia.owl")
+
+
+def _copy_templates():
+    """One renamed copy of the GALEN module as OFN text lines, with
+    ``__copy0`` as the substitution anchor (same renaming scheme as
+    ``multiply_ontology``; out-of-profile axioms are dropped here and
+    counted, as the normalizer would)."""
+    from distel_tpu.frontend.ontology_tools import _rename_axiom
+    from distel_tpu.owl import rdfxml, syntax as S
+    from distel_tpu.owl.writer import axiom_to_str
+
+    onto = rdfxml.parse_file(GALEN)
+    lines = []
+    dropped = 0
+    for ax in onto.axioms:
+        if isinstance(ax, S.UnsupportedAxiom):
+            dropped += 1
+            continue
+        lines.append(axiom_to_str(_rename_axiom(ax, 0)))
+    return "\n".join(lines), dropped
+
+
+def _ingest(text: str):
+    """Native C++ load plane when built, Python fallback otherwise."""
+    from distel_tpu.owl import native_loader
+
+    if native_loader.native_available():
+        return native_loader.load_indexed(text), "native"
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import parser
+
+    return index_ontology(normalize(parser.parse(text))), "python"
+
+
+def run_plain(n_copies: int) -> dict:
+    from distel_tpu.core.components import (
+        partition_index,
+        saturate_components,
+    )
+
+    rec = {"mode": "plain", "copies": n_copies}
+    t0 = time.time()
+    template, dropped = _copy_templates()
+    text = "\n".join(
+        template.replace("__copy0", f"__copy{k}") for k in range(n_copies)
+    )
+    rec["gen_s"] = round(time.time() - t0, 1)
+    rec["axioms"] = (template.count("\n") + 1) * n_copies
+    rec["dropped_out_of_profile"] = dropped * n_copies
+
+    t0 = time.time()
+    idx, path = _ingest(text)
+    del text
+    rec["ingest_s"] = round(time.time() - t0, 1)
+    rec["ingest_path"] = path
+    rec["n_concepts"] = idx.n_concepts
+    rec["n_links"] = idx.n_links
+
+    t0 = time.time()
+    comps = partition_index(idx, with_names=False)
+    rec["partition_s"] = round(time.time() - t0, 1)
+    rec["n_components"] = len(comps)
+
+    agg = saturate_components(comps)
+    rec["n_groups"] = agg["n_groups"]
+    rec["solve_s"] = agg["wall_s"]  # includes the one-time jit compile
+    rec["solve_warm_s"] = agg["wall_warm_s"]
+    rec["iterations_max"] = agg["iterations_max"]
+    rec["derivations"] = agg["derivations"]
+    rec["derivations_per_s"] = round(
+        agg["derivations"] / max(agg["wall_warm_s"], 1e-9), 1
+    )
+    rec["end_to_end_s"] = round(
+        rec["gen_s"] + rec["ingest_s"] + rec["partition_s"] + rec["solve_s"],
+        1,
+    )
+    return rec
+
+
+def run_crossed(n_copies: int) -> dict:
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.frontend.ontology_tools import multiply_ontology
+    from distel_tpu.owl import rdfxml
+
+    rec = {"mode": "crossed", "copies": n_copies}
+    t0 = time.time()
+    onto = multiply_ontology(rdfxml.parse_file(GALEN), n_copies, crossed=True)
+    rec["axioms"] = len(onto.axioms)
+    idx = index_ontology(normalize(onto))
+    rec["ingest_s"] = round(time.time() - t0, 1)
+    rec["n_concepts"] = idx.n_concepts
+    rec["n_links"] = idx.n_links
+    engine = RowPackedSaturationEngine(idx)
+    t0 = time.time()
+    res = engine.saturate()
+    cold = time.time() - t0
+    t0 = time.time()
+    res = engine.saturate()
+    warm = time.time() - t0
+    rec.update(
+        solve_cold_s=round(cold, 1),
+        solve_s=round(warm, 2),
+        iterations=res.iterations,
+        derivations=int(res.derivations),
+        derivations_per_s=round(res.derivations / warm, 1),
+    )
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--copies", default="64,512,4096,16384,65536")
+    ap.add_argument("--crossed-copies", default="16,64,256")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from distel_tpu.config import enable_compile_cache
+
+    enable_compile_cache()
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(line + "\n")
+
+    for n in [int(x) for x in args.copies.split(",") if x]:
+        emit(run_plain(n))
+    for n in [int(x) for x in args.crossed_copies.split(",") if x]:
+        emit(run_crossed(n))
+
+
+if __name__ == "__main__":
+    main()

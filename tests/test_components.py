@@ -1,0 +1,142 @@
+"""Component partitioning + batched saturation (core/components.py).
+
+The weak-scaling corpus (``multiply_ontology``, reference
+``samples/OntologyMultiplier.java``) is a disjoint union of renamed
+copies; the partitioner must discover the blocks and the batched fixed
+point must reproduce exactly the closure the monolithic engine computes
+over the union."""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.components import partition_index, saturate_components
+from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import (
+    multiply_ontology,
+    synthetic_ontology,
+)
+from distel_tpu.owl import parser
+
+
+def _small_onto():
+    return parser.parse(
+        synthetic_ontology(
+            n_classes=60, n_anatomy=20, n_locations=15, n_definitions=8
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def multiplied():
+    onto = multiply_ontology(_small_onto(), 5)
+    norm = normalize(onto)
+    idx = index_ontology(norm)
+    return norm, idx
+
+
+def test_partition_finds_copies(multiplied):
+    _, idx = multiplied
+    comps = partition_index(idx)
+    # five renamed copies => at least five components, grouped into as
+    # many isomorphism classes as one copy has (copies are identical)
+    assert len(comps) >= 5
+    sigs = {c.signature() for c in comps}
+    assert len(sigs) * 5 <= len(comps) or len(sigs) < len(comps)
+    # every global concept lands in exactly one component
+    seen = np.concatenate([c.global_concepts for c in comps])
+    assert len(seen) == len(set(seen.tolist()))
+    # ⊤/⊥ never appear in a component's global map
+    assert TOP_ID not in seen and BOTTOM_ID not in seen
+
+
+def test_batched_equals_monolithic(multiplied):
+    _, idx = multiplied
+    whole = RowPackedSaturationEngine(idx).saturate()
+    comps = partition_index(idx)
+    agg = saturate_components(comps)
+    assert agg["derivations"] == whole.derivations
+    assert agg["n_components"] == len(comps)
+
+
+def test_component_closure_matches_restriction(multiplied):
+    """Classify one component alone; its S rows must equal the whole
+    corpus's closure restricted to the component's concepts."""
+    _, idx = multiplied
+    whole = RowPackedSaturationEngine(idx).saturate()
+    comp = partition_index(idx)[0]
+    res = RowPackedSaturationEngine(comp.idx).saturate()
+    g = comp.global_concepts
+    n_local = comp.idx.n_concepts
+    s_local = res.s[:n_local, :n_local]
+    for a_loc in range(2, n_local):
+        subs_local = {
+            int(i) for i in np.nonzero(s_local[a_loc])[0]
+        }
+        mapped = {
+            int(g[i - 2]) if i >= 2 else i for i in subs_local
+        }
+        subs_global = {
+            int(i)
+            for i in np.nonzero(whole.s[g[a_loc - 2], : idx.n_concepts])[0]
+            # restrict to this component's vocabulary + ⊤/⊥
+            if i in (TOP_ID, BOTTOM_ID) or i in set(g.tolist())
+        }
+        assert mapped == subs_global
+
+
+def test_bottom_stays_component_local():
+    base = _small_onto()
+    onto = multiply_ontology(base, 3)
+    # poison copy 0 only: a disjointness that fires
+    from distel_tpu.owl import syntax as S
+
+    a = S.Class(sorted(c.iri for c in base.classes())[0] + "__copy0")
+    onto.add(S.SubClassOf(a, S.OWL_NOTHING))
+    norm = normalize(onto)
+    idx = index_ontology(norm)
+    whole = RowPackedSaturationEngine(idx).saturate()
+    comps = partition_index(idx)
+    agg = saturate_components(comps)
+    assert agg["derivations"] == whole.derivations
+    # the poisoned copy is no longer isomorphic to the clean ones
+    assert agg["n_groups"] >= 2
+
+
+def test_top_bottom_row_forces_fallback():
+    from distel_tpu.owl import syntax as S
+
+    onto = _small_onto()
+    onto.add(S.SubClassOf(S.OWL_THING, S.OWL_NOTHING))  # global poison
+    idx = index_ontology(normalize(onto))
+    comps = partition_index(idx)
+    assert len(comps) == 1
+    assert comps[0].idx is idx  # unpartitioned fallback
+
+
+def test_top_lhs_row_forces_fallback():
+    """⊤ ⊑ B fires on EVERY concept column (S_T[⊤] is all-ones) — its
+    conclusion lands in components that never see the row, so the
+    partitioner must refuse to split; the batched result must still
+    match the monolithic closure through the fallback."""
+    from distel_tpu.owl import syntax as S
+
+    onto = multiply_ontology(_small_onto(), 3)
+    b = sorted(c.iri for c in onto.classes())[0]
+    onto.add(S.SubClassOf(S.OWL_THING, S.Class(b)))
+    idx = index_ontology(normalize(onto))
+    comps = partition_index(idx)
+    assert len(comps) == 1 and comps[0].idx is idx
+    whole = RowPackedSaturationEngine(idx).saturate()
+    agg = saturate_components(comps)
+    assert agg["derivations"] == whole.derivations
+
+
+def test_with_names_false_skips_tables(multiplied):
+    _, idx = multiplied
+    comps = partition_index(idx, with_names=False)
+    assert comps and comps[0].idx.concept_names == []
+    agg = saturate_components(comps)
+    whole = RowPackedSaturationEngine(idx).saturate()
+    assert agg["derivations"] == whole.derivations
