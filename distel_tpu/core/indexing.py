@@ -215,25 +215,53 @@ class Indexer:
         for a, r, b in norm.nf3:
             nf3_rows.append((self.concept(a), link(self.role(r), self.concept(b))))
 
-        # close links under chain heads; compute chain_pairs
+        # close links under chain heads; compute chain_pairs.  Links are
+        # bucketed by role with a per-(chain, role) cursor so every
+        # (chain axiom, link) pair is visited ONCE — the naive rescan of
+        # the whole link table per chain per round is O(chains x links x
+        # rounds), which is quadratic in copies on multiplied corpora
+        # (measured: 17 s to index 512 GALEN copies, dominated by this
+        # loop).  Same output set; chain_pairs are sorted below, so the
+        # emitted order is unchanged.
         chain_pairs: List[Tuple[int, int, int]] = []
         if nf6_rows:
+            by_role: Dict[int, List[int]] = {}
+            for li, (r2, _f2) in enumerate(links):
+                by_role.setdefault(r2, []).append(li)
+
+            def link_b(r: int, f: int) -> int:
+                """link() that also maintains the role buckets."""
+                n_before = len(links)
+                lid = link(r, f)
+                if lid == n_before:
+                    by_role.setdefault(r, []).append(lid)
+                return lid
+
             seen_pairs = set()
+            cursors: Dict[Tuple[int, int], int] = {}
+            # relevant source roles per chain row: rho ⊑* s
+            relevant = [
+                np.flatnonzero(closure[:, s]) for (_r, s, _t) in nf6_rows
+            ]
             changed = True
             while changed:
                 changed = False
-                for (r, s, t) in nf6_rows:
-                    # snapshot: links may grow while iterating
-                    for l2 in range(len(links)):
-                        r2, f2 = links[l2]
-                        if not closure[r2, s]:
+                for ci, (r, s, t) in enumerate(nf6_rows):
+                    for rho in relevant[ci]:
+                        bucket = by_role.get(int(rho))
+                        if not bucket:
                             continue
-                        lt = link(t, f2)
-                        key2 = (r, l2, lt)
-                        if key2 not in seen_pairs:
-                            seen_pairs.add(key2)
-                            chain_pairs.append(key2)
-                            changed = True
+                        cur = cursors.get((ci, int(rho)), 0)
+                        while cur < len(bucket):
+                            l2 = bucket[cur]
+                            cur += 1
+                            lt = link_b(t, links[l2][1])
+                            key2 = (r, l2, lt)
+                            if key2 not in seen_pairs:
+                                seen_pairs.add(key2)
+                                chain_pairs.append(key2)
+                                changed = True
+                        cursors[(ci, int(rho))] = cur
 
         for r, a, b in norm.nf4:
             nf4_rows.append((self.role(r), self.concept(a), self.concept(b)))
@@ -278,15 +306,45 @@ class Indexer:
 
 
 def _role_closure(n_roles: int, edges: List[Tuple[int, int]]) -> np.ndarray:
-    """Reflexive-transitive closure H[r, s] = r ⊑* s via boolean Warshall
-    (Nr is small: SNOMED has ~60 roles)."""
+    """Reflexive-transitive closure H[r, s] = r ⊑* s by repeated
+    squaring: log₂(diameter) boolean matmuls (BLAS for normal role
+    counts, scipy sparse beyond 4096 — multiplied corpora reach tens of
+    thousands of roles, where the old per-k Warshall outer-product loop
+    was O(n³) in Python and ran for hours)."""
     n = max(n_roles, 1)
-    h = np.eye(n, dtype=bool)
-    for r, s in edges:
-        h[r, s] = True
-    for k in range(n):
-        h |= np.outer(h[:, k], h[k, :])
-    return h
+    if not edges:
+        return np.eye(n, dtype=bool)
+    if n <= 4096:
+        h = np.eye(n, dtype=bool)
+        e = np.asarray(edges, np.int64)
+        h[e[:, 0], e[:, 1]] = True
+        while True:
+            # f32 accumulation: a uint8 product wraps mod 256, and a
+            # witness count that lands on exactly 0 mod 256 would drop
+            # a true reachability bit; f32 is exact below 2^24
+            h2 = (
+                h.astype(np.float32) @ h.astype(np.float32) > 0
+            ) | h
+            if np.array_equal(h2, h):
+                return h
+            h = h2
+    from scipy.sparse import csr_matrix, eye as speye
+
+    e = np.asarray(edges, np.int64)
+    h = (
+        csr_matrix(
+            (np.ones(len(e), np.float32), (e[:, 0], e[:, 1])), shape=(n, n)
+        )
+        + speye(n, dtype=np.float32, format="csr")
+    )
+    h.data[:] = 1.0  # idempotent weights: products count paths, not wrap
+    while True:
+        h2 = h @ h + h
+        h2.data[:] = 1.0
+        h2.eliminate_zeros()
+        if h2.nnz == h.nnz:
+            return h.toarray().astype(bool)
+        h = h2
 
 
 def index_ontology(norm: NormalizedOntology) -> IndexedOntology:

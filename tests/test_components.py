@@ -133,6 +133,87 @@ def test_top_lhs_row_forces_fallback():
     assert agg["derivations"] == whole.derivations
 
 
+def test_text_partition_groups_copies():
+    """Text-level splitter (frontend/partition_text.py): n renamed
+    copies collapse to ONE canonical group whose batched execution
+    matches the monolithic closure — without ever building the global
+    index (the role-quadratic wall at weak-scaling size)."""
+    from distel_tpu.core.components import saturate_isomorphic
+    from distel_tpu.frontend.partition_text import partition_ofn_text
+    from distel_tpu.owl.writer import axiom_to_str
+    from distel_tpu.owl import syntax as S
+
+    onto = multiply_ontology(_small_onto(), 6)
+    text = "\n".join(
+        axiom_to_str(ax)
+        for ax in onto.axioms
+        if not isinstance(ax, S.UnsupportedAxiom)
+    )
+    parts = partition_ofn_text(text)
+    assert not parts.fallback
+    assert sum(c for _, c in parts.groups) >= 6
+    # monolithic ground truth
+    idx = index_ontology(normalize(onto))
+    whole = RowPackedSaturationEngine(idx).saturate()
+    total = 0
+    for rep_text, count in parts.groups:
+        from distel_tpu.owl import parser as ofn_parser
+
+        ridx = index_ontology(normalize(ofn_parser.parse(rep_text)))
+        total += saturate_isomorphic(ridx, count)["derivations"]
+    assert total == whole.derivations
+
+
+def test_text_partition_top_lhs_fallback():
+    from distel_tpu.frontend.partition_text import partition_ofn_text
+
+    parts = partition_ofn_text(
+        "SubClassOf(owl:Thing B)\nSubClassOf(C D)"
+    )
+    assert parts.fallback
+    assert len(parts.groups) == 1
+    # ⊤ hiding inside an EquivalentClasses becomes an nf1 LHS too
+    assert partition_ofn_text(
+        "EquivalentClasses(B owl:Thing)\nSubClassOf(C D)"
+    ).fallback
+    # unknown top-level constructs: tokens untrustworthy — refuse split
+    assert partition_ofn_text(
+        "HasKey(A r)\nSubClassOf(C D)"
+    ).fallback
+    # ⊤ in harmless positions must NOT force fallback
+    ok = partition_ofn_text(
+        "SubClassOf(A owl:Thing)\nSubClassOf(C D)"
+    )
+    assert not ok.fallback and len(ok.groups) == 2
+
+
+def test_chain_target_role_stays_with_component():
+    """A chain whose produced link has filler ⊤ must keep the target
+    role in the first-leg role's component (review finding: the lt link
+    was rank-dropped and the remapped chain row indexed -1)."""
+    text = (
+        "SubClassOf(A ObjectSomeValuesFrom(r owl:Thing))\n"
+        "SubObjectPropertyOf(ObjectPropertyChain(r r) t)\n"
+        "SubClassOf(X Y)"  # second, disjoint component
+    )
+    idx = index_ontology(normalize(parser.parse(text)))
+    comps = partition_index(idx)
+    for c in comps:
+        assert (c.idx.chain_pairs >= 0).all()
+        assert (c.idx.links >= 0).all()
+    whole = RowPackedSaturationEngine(idx).saturate()
+    agg = saturate_components(comps)
+    assert agg["derivations"] == whole.derivations
+
+
+def test_partition_roles_only_corpus():
+    """Role-axiom-only corpora (no kept concepts) must partition to an
+    empty component list, not crash (review finding: empty uniq made
+    rank_of index uniq[-1])."""
+    idx = index_ontology(normalize(parser.parse("SubObjectPropertyOf(r s)")))
+    assert partition_index(idx) == []
+
+
 def test_with_names_false_skips_tables(multiplied):
     _, idx = multiplied
     comps = partition_index(idx, with_names=False)
