@@ -92,6 +92,86 @@ def cmd_stream(args) -> int:
     return 0
 
 
+def cmd_partition(args) -> int:
+    """Partitioned classification: discover interaction components,
+    batch isomorphic ones through one compiled fixed point
+    (``core/components.py`` — the weak-scaling path for
+    OntologyMultiplier-style corpora, README "Weak scaling").  OFN
+    corpora partition at TEXT level before any index exists (the
+    monolithic dense index is role-quadratic and impossible at
+    multiplied-corpus scale); other formats, and corpora with
+    global-conclusion axioms, partition at index level or fall back to
+    monolithic classification — always sound."""
+    from distel_tpu.config import ClassifierConfig, enable_compile_cache
+    from distel_tpu.core.components import (
+        partition_index,
+        saturate_components,
+        saturate_isomorphic,
+    )
+    from distel_tpu.owl import loader as owl_loader
+
+    enable_compile_cache()
+    cfg = (
+        ClassifierConfig.from_properties(args.config)
+        if args.config
+        else ClassifierConfig()
+    )
+    # utf-8-sig: a BOM would otherwise glue onto the first functor and
+    # silently defeat the text-level splitter (loader.load_file parity)
+    with open(args.ontology, "r", encoding="utf-8-sig") as f:
+        text = f.read()
+    out = {"file": args.ontology}
+    t0 = time.time()
+    if owl_loader.detect_format(text) == "ofn":
+        from distel_tpu.frontend.partition_text import partition_ofn_text
+
+        parts = partition_ofn_text(text)
+        out["text_fallback"] = parts.fallback
+        if not parts.fallback:
+            from distel_tpu.owl import native_loader
+
+            use_native = (
+                cfg.use_native_loader and native_loader.native_available()
+            )
+            out["level"] = "text"
+            out["n_components"] = sum(c for _, c in parts.groups)
+            out["n_groups"] = len(parts.groups)
+            derivs = 0
+            iters = 0
+            for rep, count in parts.groups:
+                if use_native:
+                    idx = native_loader.load_indexed(rep)
+                else:
+                    from distel_tpu.core.indexing import index_ontology
+                    from distel_tpu.frontend.normalizer import normalize
+
+                    idx = index_ontology(normalize(owl_loader.load(rep)))
+                g = saturate_isomorphic(idx, count)
+                derivs += g["derivations"]
+                iters = max(iters, g["iterations"])
+            out.update(derivations=derivs, iterations_max=iters)
+            out["wall_s"] = round(time.time() - t0, 3)
+            print(json.dumps(out, indent=2))
+            return 0
+    # index-level partition (non-OFN formats, or text-level fallback)
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.frontend.normalizer import normalize
+
+    idx = index_ontology(normalize(owl_loader.load(text)))
+    comps = partition_index(idx)
+    agg = saturate_components(comps)
+    out["level"] = "index"
+    out.update(
+        n_components=agg["n_components"],
+        n_groups=agg["n_groups"],
+        derivations=agg["derivations"],
+        iterations_max=agg["iterations_max"],
+        wall_s=round(time.time() - t0, 3),
+    )
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_normalize(args) -> int:
     from distel_tpu.frontend.normalizer import normalize
     from distel_tpu.owl import loader as parser_compat
@@ -287,6 +367,14 @@ def main(argv=None) -> int:
     m.add_argument("--output", "-o", required=True)
     m.add_argument("--crossed", action="store_true")
     m.set_defaults(fn=cmd_multiply)
+
+    pt = sub.add_parser(
+        "partition",
+        help="component-partitioned classification (weak-scaling path)",
+    )
+    pt.add_argument("ontology")
+    pt.add_argument("--config", help="properties/config file")
+    pt.set_defaults(fn=cmd_partition)
 
     d = sub.add_parser("diff", help="verify against the CPU oracle")
     d.add_argument("ontology")

@@ -214,6 +214,33 @@ def test_partition_roles_only_corpus():
     assert partition_index(idx) == []
 
 
+def test_cli_partition_subcommand(tmp_path, capsys):
+    """`cli partition` routes OFN corpora through the text-level
+    splitter and prints the aggregate summary."""
+    import json
+
+    from distel_tpu.cli import main
+    from distel_tpu.owl.writer import axiom_to_str
+    from distel_tpu.owl import syntax as S
+
+    onto = multiply_ontology(_small_onto(), 4)
+    f = tmp_path / "x4.ofn"
+    f.write_text(
+        "\n".join(
+            axiom_to_str(a)
+            for a in onto.axioms
+            if not isinstance(a, S.UnsupportedAxiom)
+        )
+    )
+    assert main(["partition", str(f)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["level"] == "text" and not out["text_fallback"]
+    assert out["n_components"] >= 4
+    idx = index_ontology(normalize(onto))
+    whole = RowPackedSaturationEngine(idx).saturate()
+    assert out["derivations"] == whole.derivations
+
+
 def test_with_names_false_skips_tables(multiplied):
     _, idx = multiplied
     comps = partition_index(idx, with_names=False)
