@@ -21,16 +21,23 @@ import sys
 import time
 
 
+def _load_cfg(args):
+    """Shared --config handling (classify/stream/partition)."""
+    from distel_tpu.config import ClassifierConfig
+
+    return (
+        ClassifierConfig.from_properties(args.config)
+        if getattr(args, "config", None)
+        else ClassifierConfig()
+    )
+
+
 def cmd_classify(args) -> int:
-    from distel_tpu.config import ClassifierConfig, enable_compile_cache
+    from distel_tpu.config import enable_compile_cache
     from distel_tpu.runtime.classifier import ELClassifier
 
     enable_compile_cache()
-    cfg = (
-        ClassifierConfig.from_properties(args.config)
-        if args.config
-        else ClassifierConfig()
-    )
+    cfg = _load_cfg(args)
     if args.mesh:
         cfg.mesh_devices = args.mesh
     cfg.instrumentation = args.instrument
@@ -55,16 +62,12 @@ def cmd_stream(args) -> int:
     delta file on top of the running closure (the reference's
     ``traffic-data-load-classify.sh`` loop; implied target there: avg
     ≤ 20 s per streamed file, ``output/analysis/StatsCollector.java``)."""
-    from distel_tpu.config import ClassifierConfig, enable_compile_cache
+    from distel_tpu.config import enable_compile_cache
     from distel_tpu.core.incremental import IncrementalClassifier
     from distel_tpu.runtime.checkpoint import Snapshotter
 
     enable_compile_cache()
-    cfg = (
-        ClassifierConfig.from_properties(args.config)
-        if args.config
-        else ClassifierConfig()
-    )
+    cfg = _load_cfg(args)
     inc = IncrementalClassifier(cfg)
     snap = (
         Snapshotter(args.snapshot_prefix, args.snapshot_interval)
@@ -102,7 +105,7 @@ def cmd_partition(args) -> int:
     multiplied-corpus scale); other formats, and corpora with
     global-conclusion axioms, partition at index level or fall back to
     monolithic classification — always sound."""
-    from distel_tpu.config import ClassifierConfig, enable_compile_cache
+    from distel_tpu.config import enable_compile_cache
     from distel_tpu.core.components import (
         partition_index,
         saturate_components,
@@ -111,11 +114,30 @@ def cmd_partition(args) -> int:
     from distel_tpu.owl import loader as owl_loader
 
     enable_compile_cache()
-    cfg = (
-        ClassifierConfig.from_properties(args.config)
-        if args.config
-        else ClassifierConfig()
-    )
+    cfg = _load_cfg(args)
+
+    def ingest(text):
+        """cfg-gated load plane (mirrors runtime/classifier.py): the
+        native C++ path for OFN when built and enabled, else the
+        Python frontend."""
+        from distel_tpu.owl import native_loader
+
+        if (
+            cfg.use_native_loader
+            and owl_loader.detect_format(text) == "ofn"
+            and native_loader.native_available()
+        ):
+            return native_loader.load_indexed(text)
+        from distel_tpu.core.indexing import index_ontology
+        from distel_tpu.frontend.normalizer import normalize
+
+        return index_ontology(normalize(owl_loader.load(text)))
+
+    # engine knobs threaded from --config (mesh_devices is NOT: the
+    # batched component path is vmapped, single-program by design)
+    engine_kw = {"matmul_dtype": cfg.matmul_jnp_dtype()}
+    max_iters = cfg.max_iterations
+
     # utf-8-sig: a BOM would otherwise glue onto the first functor and
     # silently defeat the text-level splitter (loader.load_file parity)
     with open(args.ontology, "r", encoding="utf-8-sig") as f:
@@ -128,25 +150,16 @@ def cmd_partition(args) -> int:
         parts = partition_ofn_text(text)
         out["text_fallback"] = parts.fallback
         if not parts.fallback:
-            from distel_tpu.owl import native_loader
-
-            use_native = (
-                cfg.use_native_loader and native_loader.native_available()
-            )
             out["level"] = "text"
             out["n_components"] = sum(c for _, c in parts.groups)
             out["n_groups"] = len(parts.groups)
             derivs = 0
             iters = 0
             for rep, count in parts.groups:
-                if use_native:
-                    idx = native_loader.load_indexed(rep)
-                else:
-                    from distel_tpu.core.indexing import index_ontology
-                    from distel_tpu.frontend.normalizer import normalize
-
-                    idx = index_ontology(normalize(owl_loader.load(rep)))
-                g = saturate_isomorphic(idx, count)
+                g = saturate_isomorphic(
+                    ingest(rep), count,
+                    max_iters=max_iters, engine_kw=engine_kw,
+                )
                 derivs += g["derivations"]
                 iters = max(iters, g["iterations"])
             out.update(derivations=derivs, iterations_max=iters)
@@ -154,12 +167,10 @@ def cmd_partition(args) -> int:
             print(json.dumps(out, indent=2))
             return 0
     # index-level partition (non-OFN formats, or text-level fallback)
-    from distel_tpu.core.indexing import index_ontology
-    from distel_tpu.frontend.normalizer import normalize
-
-    idx = index_ontology(normalize(owl_loader.load(text)))
-    comps = partition_index(idx)
-    agg = saturate_components(comps)
+    comps = partition_index(ingest(text))
+    agg = saturate_components(
+        comps, max_iters=max_iters, engine_kw=engine_kw
+    )
     out["level"] = "index"
     out.update(
         n_components=agg["n_components"],

@@ -212,6 +212,21 @@ class RowPackedSaturationEngine:
         large = state_bytes > (
             (3 << 29) if mesh is not None else (5 << 29)
         )
+        # third tier: past ~4.5 GB of per-shard state only a 64 MB
+        # chunk budget leaves room for the scheduler's concurrent chunk
+        # temporaries (measured at 128k many-role on a 16 GB v5e: 2^26
+        # runs at 8.2 GB temp, 2^27+ OOMs).  Mesh runs tip at ~3.8 GB —
+        # below the single-chip threshold because the per-shard budget
+        # must also hold the replicated plan constants: the r3
+        # calibration caught the 300k/8 shape (4.32 GB/shard, narrowly
+        # under the single-chip threshold) compiling 29.9 GB of
+        # per-shard temp under tier 2 (SCALE_r03.json
+        # calibration.300k_fit), while the 200k/8 shape (1.92 GB/shard)
+        # measures fine under tier 2 and must not regress to
+        # serialized 64 MB chunks
+        tier3 = state_bytes > (
+            (7 << 29) if mesh is not None else (9 << 29)
+        )
         if unroll is None:
             # second tier: past ~4.8 GB of per-shard state the second
             # unrolled body's live chunk buffers alone break one chip
@@ -219,11 +234,7 @@ class RowPackedSaturationEngine:
             unroll = 1 if state_bytes > (9 << 29) else 2
         self.unroll = max(int(unroll), 1)
         if temp_budget_bytes is None:
-            if state_bytes > (9 << 29):
-                # third tier: at ≥ ~5 GB state only a 64 MB chunk budget
-                # leaves room for the scheduler's concurrent chunk
-                # temporaries (measured at 128k many-role on a 16 GB
-                # v5e: 2^26 runs at 8.2 GB temp, 2^27+ OOMs)
+            if tier3:
                 temp_budget_bytes = 1 << 26
             else:
                 temp_budget_bytes = (1 << 28) if large else (1 << 29)
@@ -232,7 +243,7 @@ class RowPackedSaturationEngine:
         # chunks' contraction temporaries and the peak is both higher
         # and run-to-run variable — 128k single-chip measured flaky at
         # 8.2 GB temp without, stable with
-        self._serialize_chunks = state_bytes > (9 << 29)
+        self._serialize_chunks = tier3
         if gate_chunks is None and large:
             gate_chunks = False
         # int8 × int8 → int32 runs 2x bf16 on the MXU and is exact
