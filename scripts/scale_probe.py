@@ -42,6 +42,12 @@ sys.path.insert(0, _REPO)
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("n_classes", type=int)
+    ap.add_argument("--shape", choices=("snomed", "galen"), default="snomed",
+                    help="corpus generator: snomed = 66-role many-role "
+                         "regime (maximal chain work), galen = 3-role "
+                         "partonomy shape (the CPU-feasible execution "
+                         "regime: the many-role schedule's MAC volume "
+                         "exceeds a single CPU core's budget by ~25x)")
     ap.add_argument("--devices", type=int, default=8,
                     help="virtual CPU mesh size; 0 = single-device on the "
                          "default backend (the real chip)")
@@ -91,16 +97,29 @@ def run_probe(args) -> None:
     from distel_tpu.core.indexing import index_ontology
     from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
     from distel_tpu.frontend.normalizer import normalize
-    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+    from distel_tpu.frontend.ontology_tools import (
+        snomed_shaped_ontology,
+        synthetic_ontology,
+    )
     from distel_tpu.owl import parser
 
     rec = {
         "n_classes": args.n_classes,
+        "shape": args.shape,
         "devices": args.devices or 1,
         "backend": jax.default_backend(),
     }
     t0 = time.time()
-    text = snomed_shaped_ontology(n_classes=args.n_classes)
+    if args.shape == "galen":
+        n = args.n_classes
+        # floors of 2: the generator draws randrange(1, n_anatomy)-style
+        # indices, so 0/1-sized sections are empty ranges at tiny n
+        text = synthetic_ontology(
+            n_classes=n, n_anatomy=max(n // 10, 2),
+            n_locations=max(n // 12, 2), n_definitions=max(n // 20, 2),
+        )
+    else:
+        text = snomed_shaped_ontology(n_classes=args.n_classes)
     norm = normalize(parser.parse(text))
     idx = index_ontology(norm)
     rec["index_s"] = round(time.time() - t0, 1)
