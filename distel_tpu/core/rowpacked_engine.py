@@ -84,6 +84,12 @@ from distel_tpu.ops.bitpack import (
 )
 
 
+#: budget-floor chunk count past which the CR4/CR6 contractions compile
+#: as uniform scanned chunks (O(1) traced bodies) instead of one traced
+#: body per chunk — see ``scan_chunks`` in the engine constructor
+_SCAN_CHUNK_THRESHOLD = 24
+
+
 def _pos_maps(writers, n_rows):
     """Layered row → concat-position maps; position ``sentinel`` indexes
     a trailing always-False slot.  Rows written by k writers occupy k
@@ -145,6 +151,8 @@ class RowPackedSaturationEngine:
         min_links_pad: int = 0,
         min_concepts: int = 0,
         link_window: Optional[Tuple[int, int]] = None,
+        scan_chunks: Optional[bool] = None,
+        scan_group_bytes: Optional[int] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -166,7 +174,15 @@ class RowPackedSaturationEngine:
         halves ``temp_budget_bytes``, trading the skip speedup for the
         ~3 GB of cond pass-through copies that otherwise OOM one chip;
         see the measured figures at the threshold computation in
-        ``__init__``)."""
+        ``__init__``).
+        ``scan_chunks``: contract the CR4/CR6 row chunks as UNIFORM
+        padded chunks under one ``lax.scan`` body per rule, with a few
+        deferred target-sorted segmented-OR writes — traced program size
+        O(1) in chunk count instead of one body per chunk (None = auto:
+        engaged once the budget-driven chunk count exceeds
+        ``_SCAN_CHUNK_THRESHOLD``, the regime where XLA pass scaling
+        over per-chunk bodies dominates compile time: measured r3 at
+        300k classes, 925 s step compile from ~10^3 chunk bodies)."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
@@ -400,14 +416,42 @@ class RowPackedSaturationEngine:
                     break
             return materialize(spans)
 
-        self._cr4_chunks = (
-            role_chunks(idx.nf4[:, 0], idx.nf4[:, 2]) if self._has4 else []
-        )
-        self._cr6_chunks = (
-            role_chunks(idx.chain_pairs[:, 0], idx.chain_pairs[:, 2])
-            if self._has6
-            else []
-        )
+        # ---- scan-mode decision: the budget floor on chunk count is
+        # ceil(rows / mm_rows) per rule; once the total crosses the
+        # threshold, per-chunk traced bodies dominate XLA compile time
+        # (super-linear pass scaling — r3 measured 925 s at the 300k
+        # shape) and the uniform-chunk lax.scan formulation takes over.
+        k4 = len(idx.nf4) if self._has4 else 0
+        k6 = len(idx.chain_pairs) if self._has6 else 0
+        est_spans = -(-k4 // mm_rows) + -(-k6 // mm_rows)
+        if scan_chunks is None:
+            scan_chunks = est_spans > _SCAN_CHUNK_THRESHOLD
+        self._scan_mode = bool(scan_chunks) and (k4 + k6) > 0
+        if self._scan_mode:
+            self._cr4_chunks, self._cr6_chunks = [], []
+            max_rk = max(min(mm_rows, max(k4, k6)), 1)
+            self._scan_rk = (
+                min(mm_rows, k4) if k4 else 0,
+                min(mm_rows, k6) if k6 else 0,
+            )
+        else:
+            self._cr4_chunks = (
+                role_chunks(idx.nf4[:, 0], idx.nf4[:, 2])
+                if self._has4
+                else []
+            )
+            self._cr6_chunks = (
+                role_chunks(idx.chain_pairs[:, 0], idx.chain_pairs[:, 2])
+                if self._has6
+                else []
+            )
+            max_rk = max(
+                [
+                    len(raw)
+                    for raw, _, _ in self._cr4_chunks + self._cr6_chunks
+                ],
+                default=1,
+            )
         # The contraction (link) axis is chunked too: a realistic
         # many-role corpus at 96k classes has ~100k links, so the
         # per-step [rk, nl] i8 operand (mask ∧ bit-table) alone would
@@ -417,10 +461,6 @@ class RowPackedSaturationEngine:
         # chunk's gathers concurrently and peak memory is back to the
         # unchunked figure.  The link axis pads up to a whole number of
         # equal chunks (padded links have all-zero mask bits — inert).
-        max_rk = max(
-            [len(raw) for raw, _, _ in self._cr4_chunks + self._cr6_chunks],
-            default=1,
-        )
         if l_chunk is not None:
             lc = min(_pad_up(max(l_chunk, 32), 32), self.nl)
         else:
@@ -536,61 +576,193 @@ class RowPackedSaturationEngine:
         # are 0, so they contribute nothing (and windows clamped at the
         # link-table tail re-derive earlier links — OR is idempotent).
         # Chunks with NO relevant links are dropped outright.
+        def live_windows(role_list):
+            """Static live L-window offsets (offs, c01) for a row span
+            whose axiom roles are ``role_list`` — shared by the per-chunk
+            and the scanned-slab builders; None when no link can satisfy
+            the span's roles.  ``c01`` holds the aligned dirty_l chunks a
+            window overlaps (≤ 2); the filler/link-role window contents
+            are dynamic slices of the SHARED [nl] tables at runtime —
+            stacking copies here would replicate them up to n_chunks
+            times in the jitted-run arguments."""
+            croles = np.unique(role_list)
+            rel = np.flatnonzero(h[:, croles].any(axis=1))
+            live = np.flatnonzero(np.isin(self._link_roles, rel))
+            if link_window is not None:
+                w0, w1 = link_window
+                live = live[(live >= w0) & (live < w1)]
+            if live.size == 0:
+                return None
+            lcn = self.lc
+            offs = []
+            i = 0
+            while i < live.size:
+                off = min(int(live[i]), self.nl - lcn)
+                offs.append(off)
+                i = int(np.searchsorted(live, off + lcn))
+            offs = np.asarray(offs, np.int32)
+            c01 = np.stack(
+                [
+                    offs // lcn,
+                    np.minimum(
+                        (offs + lcn - 1) // lcn, self.n_lchunks - 1
+                    ),
+                ],
+                axis=1,
+            ).astype(np.int32)
+            return offs, c01
+
         def build_tiles(chunks, role_of):
             kept, tiles = [], []
-            lcn = self.lc
             for raw, inv, piece in chunks:
-                croles = np.unique(role_of(raw))
-                rel = np.flatnonzero(h[:, croles].any(axis=1))
-                live = np.flatnonzero(np.isin(self._link_roles, rel))
-                if link_window is not None:
-                    w0, w1 = link_window
-                    live = live[(live >= w0) & (live < w1)]
-                if live.size == 0:
+                win = live_windows(role_of(raw))
+                if win is None:
                     continue
-                offs = []
-                i = 0
-                while i < live.size:
-                    off = min(int(live[i]), self.nl - lcn)
-                    offs.append(off)
-                    i = int(np.searchsorted(live, off + lcn))
-                offs = np.asarray(offs, np.int32)
-                # aligned dirty_l chunks a window overlaps (≤ 2); the
-                # filler/link-role window contents are dynamic slices of
-                # the SHARED [nl] tables at runtime — stacking copies
-                # here would replicate them up to n_chunks times in the
-                # jitted-run arguments
-                c01 = np.stack(
-                    [
-                        offs // lcn,
-                        np.minimum(
-                            (offs + lcn - 1) // lcn, self.n_lchunks - 1
-                        ),
-                    ],
-                    axis=1,
-                ).astype(np.int32)
                 kept.append((raw, inv, piece))
-                tiles.append((jnp.asarray(offs), jnp.asarray(c01)))
+                tiles.append((jnp.asarray(win[0]), jnp.asarray(win[1])))
             return kept, tiles
 
-        self._cr4_chunks, self._cr4_tiles = build_tiles(
-            self._cr4_chunks, lambda raw: idx.nf4[raw, 0]
-        )
-        self._cr6_chunks, self._cr6_tiles = build_tiles(
-            self._cr6_chunks, lambda raw: idx.chain_pairs[raw, 0]
-        )
+        def build_scan(rk, tab_roles, rows_src, tab_targets, mask_tab,
+                       fd_idx, fd_pad, want_readers=True):
+            """Uniform padded chunk slabs for one rule's scanned
+            contraction: the role-sorted table splits into spans of
+            exactly ``rk`` rows (tail zero-padded — padded rows have
+            all-zero mask rows, so they contribute nothing), each span
+            keeps its role-aware live-window table padded to the common
+            window count, and chunks are batched into GROUPS whose
+            padded matmul outputs are then OR-combined by ONE deferred
+            target-sorted segmented-OR write per group (``SegmentedRowOr``
+            over the group's padded target list — pad targets land in
+            row 0's segment with zero rows, a no-op under OR).  The
+            traced program is one ``lax.scan`` body + one write per
+            group — O(1) in chunk count.  ``fd_idx``/``fd_pad``: per-row
+            indices into the rule's change-source vector (S-row mask for
+            CR4, dirty_l for CR6; pad = the appended always-False slot),
+            folded to a per-chunk dirty scalar by one vectorized gather."""
+            K = len(tab_roles)
+            spans = [(o, min(o + rk, K)) for o in range(0, K, rk)]
+            rows_l, fdx_l, m_l = [], [], []
+            offs_l, c01_l, tgt_l, reader_rows = [], [], [], []
+            for a0, a1 in spans:
+                win = live_windows(tab_roles[a0:a1])
+                if win is None:
+                    continue
+                pad = rk - (a1 - a0)
+                rows_l.append(np.pad(rows_src[a0:a1], (0, pad)))
+                fdx_l.append(
+                    np.pad(fd_idx[a0:a1], (0, pad), constant_values=fd_pad)
+                )
+                m_l.append(np.pad(mask_tab[a0:a1], ((0, pad), (0, 0))))
+                offs_l.append(win[0])
+                c01_l.append(win[1])
+                tgt_l.append(np.pad(tab_targets[a0:a1], (0, pad)))
+                if want_readers:
+                    reader_rows.append(rows_src[a0:a1])
+            if not rows_l:
+                return None
+            nch = len(rows_l)
+            n_windows = np.asarray([len(o) for o in offs_l])
+            T = int(n_windows.max())
+            offs_s = np.zeros((nch, T), np.int32)
+            c01_s = np.zeros((nch, T, 2), np.int32)
+            tval_s = np.zeros((nch, T), bool)
+            for i, (o, c) in enumerate(zip(offs_l, c01_l)):
+                offs_s[i, : len(o)] = o
+                c01_s[i, : len(o)] = c
+                tval_s[i, : len(o)] = True
+            # group size bounds the deferred per-group output buffer
+            # ([gch·rk, wlw] u32 — the memory cost of deferring the
+            # seg-OR); tier-3 postures halve it.  ``scan_group_bytes``
+            # is the test hook for forcing multi-group splits at small
+            # corpus sizes
+            group_bytes = scan_group_bytes or (
+                1 << (27 if self._serialize_chunks else 28)
+            )
+            wlw = self.wc // self.n_shards
+            gch = max(int(group_bytes // max(rk * wlw * 4, 1)), 1)
+            groups = []
+            for g0 in range(0, nch, gch):
+                g1 = min(g0 + gch, nch)
+                tg = np.concatenate(tgt_l[g0:g1])
+                groups.append(
+                    (
+                        g0,
+                        g1,
+                        SegmentedRowOr(tg),
+                        # gate-reader rows: only the CR4 flags consult
+                        # them (CR6 groups re-dirty on ANY R change)
+                        np.unique(np.concatenate(reader_rows[g0:g1]))
+                        if want_readers
+                        else None,
+                    )
+                )
+            slabs = tuple(
+                jnp.asarray(x)
+                for x in (
+                    np.stack(rows_l).astype(np.int32),
+                    np.stack(fdx_l).astype(np.int32),
+                    np.stack(m_l),
+                    offs_s,
+                    c01_s,
+                    tval_s,
+                )
+            )
+            return {
+                "rk": rk,
+                "nch": nch,
+                "T": T,
+                "groups": groups,
+                "slabs": slabs,
+                "n_windows": n_windows,
+            }
+
         # the whole plan-table pytree (closure masks + live-tile
         # schedules) stays an ARGUMENT to the jitted run — embedded
         # constants get serialized into every remote compile request
         # and replicated per shard
-        self._masks = (
-            jnp.asarray(m4),
-            jnp.asarray(m6),
-            jnp.asarray(self._fillers.astype(np.int32)),
-            jnp.asarray(self._link_roles),
-            tuple(self._cr4_tiles),
-            tuple(self._cr6_tiles),
-        )
+        if self._scan_mode:
+            rk4, rk6 = self._scan_rk
+            self._scan4 = (
+                build_scan(
+                    rk4, idx.nf4[:, 0], self._a4, idx.nf4[:, 2], m4,
+                    self._a4, self.nc,
+                )
+                if self._has4
+                else None
+            )
+            self._scan6 = (
+                build_scan(
+                    rk6, idx.chain_pairs[:, 0], self._l26,
+                    idx.chain_pairs[:, 2], m6,
+                    self._l26 // self.lc, self.n_lchunks,
+                    want_readers=False,
+                )
+                if self._has6
+                else None
+            )
+            self._cr4_tiles, self._cr6_tiles = [], []
+            self._masks = (
+                jnp.asarray(self._fillers.astype(np.int32)),
+                jnp.asarray(self._link_roles),
+                self._scan4["slabs"] if self._scan4 else (),
+                self._scan6["slabs"] if self._scan6 else (),
+            )
+        else:
+            self._scan4 = self._scan6 = None
+            self._cr4_chunks, self._cr4_tiles = build_tiles(
+                self._cr4_chunks, lambda raw: idx.nf4[raw, 0]
+            )
+            self._cr6_chunks, self._cr6_tiles = build_tiles(
+                self._cr6_chunks, lambda raw: idx.chain_pairs[raw, 0]
+            )
+            self._masks = (
+                jnp.asarray(m4),
+                jnp.asarray(m6),
+                jnp.asarray(self._fillers.astype(np.int32)),
+                jnp.asarray(self._link_roles),
+                tuple(self._cr4_tiles),
+                tuple(self._cr6_tiles),
+            )
 
         # one packed-output matmul plan per row-chunk, shared by every
         # (equal-sized) L-window.  dtype: forwarded only when the caller
@@ -603,14 +775,32 @@ class RowPackedSaturationEngine:
         if mm_opts:
             mm_kw.update(mm_opts)
         wl = self.wc // self.n_shards
-        self._cr4_mm = [
-            PackedColsMatmulPlan(len(raw), lc, wl, **mm_kw)
-            for raw, _, _ in self._cr4_chunks
-        ]
-        self._cr6_mm = [
-            PackedColsMatmulPlan(len(raw), lc, wl, **mm_kw)
-            for raw, _, _ in self._cr6_chunks
-        ]
+        if self._scan_mode:
+
+            def scan_mm(rk):
+                # the ONE plan all scanned chunks share; under the XLA
+                # fallback the m-axis pad is pure wasted MACs, so align
+                # it to 8 instead of the Pallas grid tile
+                kw2 = dict(mm_kw)
+                if kw2.get("use_xla") and "tm" not in kw2:
+                    kw2["tm"] = max(_pad_up(rk, 8), 8)
+                return PackedColsMatmulPlan(rk, lc, wl, **kw2)
+
+            self._cr4_mm = (
+                [scan_mm(self._scan_rk[0])] if self._scan4 else []
+            )
+            self._cr6_mm = (
+                [scan_mm(self._scan_rk[1])] if self._scan6 else []
+            )
+        else:
+            self._cr4_mm = [
+                PackedColsMatmulPlan(len(raw), lc, wl, **mm_kw)
+                for raw, _, _ in self._cr4_chunks
+            ]
+            self._cr6_mm = [
+                PackedColsMatmulPlan(len(raw), lc, wl, **mm_kw)
+                for raw, _, _ in self._cr6_chunks
+            ]
 
         # live-column word mask: bits for x < n_concepts only
         wmask = np.zeros(self.wc, np.uint32)
@@ -646,15 +836,29 @@ class RowPackedSaturationEngine:
         # row → concat-position gather maps (_pos_maps — a scatter would
         # serialize per index on TPU) shared by the rule gate and the
         # L-frontier fold
+        if self._scan_mode:
+            w4_targets = [
+                g[2].targets
+                for g in (self._scan4["groups"] if self._scan4 else [])
+            ]
+            w6_targets = [
+                g[2].targets
+                for g in (self._scan6["groups"] if self._scan6 else [])
+            ]
+        else:
+            w4_targets = [
+                piece.targets for _, _, piece in self._cr4_chunks
+            ]
+            w6_targets = [
+                piece.targets for _, _, piece in self._cr6_chunks
+            ]
         s_writers = (
             ([self._p1.targets] if self._p1.k else [])
             + ([self._p2.targets] if self._p2.k else [])
-            + [piece.targets for _, _, piece in self._cr4_chunks]
+            + w4_targets
             + ([np.asarray([BOTTOM_ID])] if self._bottom else [])
         )
-        r_writers = ([self._p3.targets] if self._p3.k else []) + [
-            piece.targets for _, _, piece in self._cr6_chunks
-        ]
+        r_writers = ([self._p3.targets] if self._p3.k else []) + w6_targets
         self._s_layers = _pos_maps(s_writers, self.nc)
         self._r_layers = _pos_maps(r_writers, self.nl)
         self._l2chunks6 = [
@@ -920,10 +1124,19 @@ class RowPackedSaturationEngine:
         re-dirties them.  Flag order == chunk execution order in
         :meth:`_step`."""
         readers = []
-        for raw, _inv, plan in self._cr4_chunks:
-            readers.append(("SR", np.unique(self._a4[raw])))
-        for raw, _inv, plan in self._cr6_chunks:
-            readers.append(("RR", None))
+        if self._scan_mode:
+            # flag granularity in scan mode is the write GROUP (the
+            # per-chunk signal lives in the scanned live/f_dirty
+            # multipliers instead of a cond)
+            for g in self._scan4["groups"] if self._scan4 else []:
+                readers.append(("SR", g[3]))
+            for _g in self._scan6["groups"] if self._scan6 else []:
+                readers.append(("RR", None))
+        else:
+            for raw, _inv, plan in self._cr4_chunks:
+                readers.append(("SR", np.unique(self._a4[raw])))
+            for raw, _inv, plan in self._cr6_chunks:
+                readers.append(("RR", None))
         if self._bottom:
             # CR5's masked OR-reduce sweeps all of R_T (scales with
             # nl·wc, unlike CR1-3's axiom-count-bound gathers), so it
@@ -992,6 +1205,24 @@ class RowPackedSaturationEngine:
                 rw += 2 * piece.n_targets * w4           # target RMW
                 macs += len(raw) * self.nl * self.nc
                 live_macs += len(raw) * n_t * self.lc * self.nc
+        for d in (self._scan4, self._scan6):
+            if d is None:
+                continue
+            rk = d["rk"]
+            n_t_total = int(d["n_windows"].sum())
+            # every chunk executes T = max(n_windows) slots; padded
+            # slots still issue their R-window dynamic_slice read (only
+            # the MXU work is zeroed), so the traffic bound charges the
+            # padded plane, not just the live windows
+            rw += d["nch"] * d["T"] * self.lc * w4       # R window reads
+            rw += d["nch"] * rk * w4                     # subt gathers
+            # deferred per-group output buffers: one write + the
+            # emission-order re-gather on top of the target RMW
+            for _g0, _g1, plan, _rows in d["groups"]:
+                rw += 2 * plan.n_targets * w4
+                rw += 2 * plan.k * w4
+            macs += d["nch"] * rk * self.nl * self.nc
+            live_macs += n_t_total * rk * self.lc * self.nc
         if self._bottom:
             rw += (self.nl + 2) * w4
         return {
@@ -1065,9 +1296,13 @@ class RowPackedSaturationEngine:
         whole-array post-comparison, so the pre-step state is dead as
         soon as the last rule reads it — without this the fixed-point
         loop carries two full copies of S and OOMs ~2x earlier."""
-        m4, m6, fills, lroles, t4, t6 = (
-            self._masks if masks is None else masks
-        )
+        mk = self._masks if masks is None else masks
+        if self._scan_mode:
+            fills, lroles, s4slabs, s6slabs = mk
+            m4 = m6 = t4 = t6 = None
+        else:
+            m4, m6, fills, lroles, t4, t6 = mk
+            s4slabs = s6slabs = None
         gating = self._gate is not None
         if dirty is None:  # stateless public step(): all-dirty
             dirty = self.initial_dirty()
@@ -1200,16 +1435,48 @@ class RowPackedSaturationEngine:
             else lax.axis_index(axis_name) * (self.wc // self.n_shards)
         )
 
+        def window_term(subt, rp_state, off, live, mask_rows, mm):
+            """One live L-window's contribution to a CR4/CR6 chunk: the
+            [rk, wlw] packed AND-OR product of the (factored-mask ∧
+            bit-table ∧ ``live``) operand against the window's R rows.
+            ``live`` zeroes the operand when nothing the window reads
+            changed last step — OR-monotone, so skipping only delays;
+            the Pallas kernel's per-tile skip flags then drop the MXU
+            work.  Shared verbatim by the unrolled and scanned
+            formulations (tests/test_scan_engine.py pins them
+            bit-identical).  Window contents slice the SHARED
+            filler/link-role tables (stacked per-chunk copies would
+            replicate them ×n_chunks in the run arguments)."""
+            fcols = lax.dynamic_slice(fills, (off,), (lc,))
+            lrole = lax.dynamic_slice(lroles, (off,), (lc,))
+            with jax.named_scope("bit_table"):
+                if axis_name is None:
+                    f = bit_lookup_from(subt, fcols, dtype=dt)
+                else:
+                    f = lax.psum(
+                        bit_lookup_from(
+                            subt, fcols,
+                            word_offset=base, dtype=jnp.int32,
+                        ),
+                        axis_name,
+                    ).astype(dt)                          # [lc, rk]
+            # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
+            w = (
+                jnp.take(mask_rows, lrole, axis=1).astype(dt)
+                * f.T
+                * live.astype(dt)
+            )
+            b = lax.dynamic_slice(rp_state, (off, 0), (lc, wlw))
+            return mm(w, b)
+
         def contract_from(
             bits_state, rp_state, rows, mask_rows, mm, f_dirty, tiles
         ):
             """``f_dirty``: scalar — did any bit-table SOURCE row of this
             chunk change last step?  A live window whose R slice is also
             clean (``dirty_l`` over the aligned chunks it overlaps)
-            re-derives nothing (OR-monotone), so its ``w`` operand is
-            zeroed and the kernel's per-tile skip flags drop the MXU
-            work — the reference's two-sided semi-naive join in tensor
-            form.  ``tiles`` is this chunk's static live-window table
+            re-derives nothing (OR-monotone) — see ``window_term``.
+            ``tiles`` is this chunk's static live-window table
             (see ``build_tiles`` in ``__init__``): the loop contracts
             only windows whose link roles can satisfy the chunk's
             axiom roles."""
@@ -1219,35 +1486,10 @@ class RowPackedSaturationEngine:
             subt = bits_state[jnp.asarray(rows)].T        # [W, rk], hoisted
 
             def one(i, acc):
-                # window contents slice the SHARED filler/link-role
-                # tables (stacked per-chunk copies would replicate them
-                # ×n_chunks in the run arguments)
-                fcols = lax.dynamic_slice(fills, (offs[i],), (lc,))
-                lrole = lax.dynamic_slice(lroles, (offs[i],), (lc,))
-                with jax.named_scope("bit_table"):
-                    if axis_name is None:
-                        f = bit_lookup_from(subt, fcols, dtype=dt)
-                    else:
-                        f = lax.psum(
-                            bit_lookup_from(
-                                subt, fcols,
-                                word_offset=base, dtype=jnp.int32,
-                            ),
-                            axis_name,
-                        ).astype(dt)                      # [lc, rk]
-                live = (
-                    dirty_l[c01[i, 0]] | dirty_l[c01[i, 1]] | f_dirty
-                ).astype(dt)
-                # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
-                w = (
-                    jnp.take(mask_rows, lrole, axis=1).astype(dt)
-                    * f.T
-                    * live
+                live = dirty_l[c01[i, 0]] | dirty_l[c01[i, 1]] | f_dirty
+                return acc | window_term(
+                    subt, rp_state, offs[i], live, mask_rows, mm
                 )
-                b = lax.dynamic_slice(
-                    rp_state, (offs[i], 0), (lc, wlw)
-                )
-                return acc | mm(w, b)
 
             if n_t == 1:
                 return one(0, jnp.zeros((rk, wlw), jnp.uint32))
@@ -1255,7 +1497,93 @@ class RowPackedSaturationEngine:
                 0, n_t, one, jnp.zeros((rk, wlw), jnp.uint32)
             )
 
-        if self._has4:
+        # ---- scanned CR4/CR6: uniform padded chunks under ONE lax.scan
+        # body per rule; per-chunk dirtiness arrives as scanned operands
+        # (live-window validity × dirty_l × the vectorized f_dirty
+        # gather) instead of per-chunk conds, and the write is a few
+        # deferred target-sorted seg-OR writes over the stacked scan
+        # output — traced program size O(1) in chunk count (see
+        # ``build_scan`` in ``__init__``)
+        if self._scan_mode:
+
+            def scan_contract(d, slabs, mm, state_src, rp_state,
+                              fd_src, g0, g1):
+                rows_s, fdx_s, m_s, offs_s, c01_s, tval_s = slabs
+                T, rk = d["T"], d["rk"]
+                fd_all = fd_src[fdx_s[g0:g1]].any(axis=1)   # [gch]
+
+                def body(_, xs):
+                    rows_k, m_k, offs_k, c01_k, tval_k, fd_k = xs
+                    subt = state_src[rows_k].T              # [width, rk]
+
+                    def one(i, acc):
+                        live = tval_k[i] & (
+                            dirty_l[c01_k[i, 0]]
+                            | dirty_l[c01_k[i, 1]]
+                            | fd_k
+                        )
+                        return acc | window_term(
+                            subt, rp_state, offs_k[i], live, m_k, mm
+                        )
+
+                    z = jnp.zeros((rk, wlw), jnp.uint32)
+                    acc = one(0, z) if T == 1 else lax.fori_loop(
+                        0, T, one, z
+                    )
+                    return (), acc
+
+                xs = (
+                    rows_s[g0:g1], m_s[g0:g1], offs_s[g0:g1],
+                    c01_s[g0:g1], tval_s[g0:g1], fd_all,
+                )
+                _, ys = lax.scan(body, (), xs)
+                return ys.reshape(-1, wlw)
+
+            if self._scan4 is not None:
+                s_changed_ext = jnp.concatenate(
+                    [s_changed, jnp.zeros(1, bool)]
+                )
+                mm4 = self._cr4_mm[0]
+                for g0, g1, gplan, _rows in self._scan4["groups"]:
+
+                    def red4s(ops, g0=g0, g1=g1, gplan=gplan):
+                        s, r = ops
+                        out = scan_contract(
+                            self._scan4, s4slabs, mm4, s, r,
+                            s_changed_ext, g0, g1,
+                        )
+                        return gplan.reduce(out[jnp.asarray(gplan.order)])
+
+                    with jax.named_scope("cr4"):
+                        red = gated_rows(gplan.n_targets, (sp, rp), red4s)
+                        sp, cv = gplan.write(sp, red, track="rows")
+                    s_vecs.append(cv)
+                    ch |= jnp.any(cv)
+                    if self._serialize_chunks:
+                        sp, rp = lax.optimization_barrier((sp, rp))
+            if self._scan6 is not None:
+                dirty_l_ext = jnp.concatenate(
+                    [dirty_l, jnp.zeros(1, bool)]
+                )
+                mm6 = self._cr6_mm[0]
+                for g0, g1, gplan, _rows in self._scan6["groups"]:
+
+                    def red6s(r, g0=g0, g1=g1, gplan=gplan):
+                        out = scan_contract(
+                            self._scan6, s6slabs, mm6, r, r,
+                            dirty_l_ext, g0, g1,
+                        )
+                        return gplan.reduce(out[jnp.asarray(gplan.order)])
+
+                    with jax.named_scope("cr6"):
+                        red = gated_rows(gplan.n_targets, rp, red6s)
+                        rp, cv = gplan.write(rp, red, track="rows")
+                    r_vecs.append(cv)
+                    ch |= jnp.any(cv)
+                    if self._serialize_chunks:
+                        sp, rp = lax.optimization_barrier((sp, rp))
+
+        if self._has4 and not self._scan_mode:
             for k, ((raw, inv, plan), mm) in enumerate(
                 zip(self._cr4_chunks, self._cr4_mm)
             ):
@@ -1283,7 +1611,7 @@ class RowPackedSaturationEngine:
                 if self._serialize_chunks:
                     sp, rp = lax.optimization_barrier((sp, rp))
         # CR6: role chains
-        if self._has6:
+        if self._has6 and not self._scan_mode:
             for k, ((raw, inv, plan), mm) in enumerate(
                 zip(self._cr6_chunks, self._cr6_mm)
             ):

@@ -60,6 +60,18 @@ def _parse_args(argv=None):
     ap.add_argument("--sample", type=int, default=2000,
                     help="concepts sampled for the containment check")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--no-aot", action="store_true",
+                    help="skip the AOT compile + memory_analysis phase "
+                         "(its step_compile_s / per_shard_* record is "
+                         "the point of compile probes, but an observed "
+                         "--execute run compiles a separate program and "
+                         "would pay the unused AOT compile twice)")
+    ap.add_argument("--progress-file", default=None,
+                    help="append one JSON line per observed superstep "
+                         "round (default: <out>.progress when --out is "
+                         "set) — the r3 128k run died at round end with "
+                         "NO record of 5+ hours of execution; this file "
+                         "makes partial progress a recorded artifact")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -136,34 +148,78 @@ def run_probe(args) -> None:
 
     # ---- AOT: compile the full fixed-point program, read its memory
     # analysis (what round 2's probe recorded; kept for trend comparison)
-    budget = 10_000 - 10_000 % engine.unroll
-    sp0, rp0 = engine.initial_state()
-    t0 = time.time()
-    if mesh is None:
-        lowered = engine._run_jit.lower(sp0, rp0, engine._masks, budget)
-    else:
-        lowered = engine._run_jit(budget).lower(sp0, rp0, engine._masks)
-    compiled = lowered.compile()
-    rec["step_compile_s"] = round(time.time() - t0, 1)
-    try:
-        ma = compiled.memory_analysis()
-        n_sh = max(engine.n_shards, 1)
-        gb = 1 / (1 << 30)
-        state_b = (engine.nc + engine.nl) * engine.wc * 4 / n_sh
-        rec["per_shard_state_gb"] = round(state_b * gb, 3)
-        rec["per_shard_temp_gb"] = round(ma.temp_size_in_bytes * gb, 2)
-        rec["per_shard_args_gb"] = round(ma.argument_size_in_bytes * gb, 2)
-        rec["per_shard_out_gb"] = round(ma.output_size_in_bytes * gb, 2)
-        rec["per_shard_total_live_gb"] = round(
-            (ma.temp_size_in_bytes + ma.argument_size_in_bytes
-             + ma.output_size_in_bytes) * gb, 2)
-    except Exception as e:  # backend without memory_analysis
-        rec["memory_analysis_error"] = str(e)
+    if not args.no_aot:
+        budget = 10_000 - 10_000 % engine.unroll
+        sp0, rp0 = engine.initial_state()
+        t0 = time.time()
+        if mesh is None:
+            lowered = engine._run_jit.lower(
+                sp0, rp0, engine._masks, budget
+            )
+        else:
+            lowered = engine._run_jit(budget).lower(
+                sp0, rp0, engine._masks
+            )
+        compiled = lowered.compile()
+        rec["step_compile_s"] = round(time.time() - t0, 1)
+        try:
+            ma = compiled.memory_analysis()
+            n_sh = max(engine.n_shards, 1)
+            gb = 1 / (1 << 30)
+            state_b = (engine.nc + engine.nl) * engine.wc * 4 / n_sh
+            rec["per_shard_state_gb"] = round(state_b * gb, 3)
+            rec["per_shard_temp_gb"] = round(ma.temp_size_in_bytes * gb, 2)
+            rec["per_shard_args_gb"] = round(
+                ma.argument_size_in_bytes * gb, 2
+            )
+            rec["per_shard_out_gb"] = round(ma.output_size_in_bytes * gb, 2)
+            rec["per_shard_total_live_gb"] = round(
+                (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                 + ma.output_size_in_bytes) * gb, 2)
+        except Exception as e:  # backend without memory_analysis
+            rec["memory_analysis_error"] = str(e)
+        del compiled, lowered
 
     if args.execute:
-        del compiled, lowered
+        progress = args.progress_file or (
+            args.out + ".progress" if args.out else None
+        )
         t0 = time.time()
-        result = engine.saturate()
+        if progress:
+            # observed fixed point: one host sync per superstep round
+            # (noise next to the multi-hour virtual-mesh step walls)
+            # buys a durable per-iteration record.  NOTE the observed
+            # program is jitted separately from the AOT-measured
+            # while-loop program above, so the FIRST round's wall below
+            # includes its compile — rec labels both so exec_wall_s is
+            # not mistaken for a pure-execution figure
+            with open(progress, "a") as f:
+                f.write(json.dumps({
+                    "run_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    **rec,
+                }) + "\n")
+            first_round = []
+
+            def observer(iteration, derivations, changed):
+                if not first_round:
+                    first_round.append(round(time.time() - t0, 1))
+                with open(progress, "a") as f:
+                    f.write(json.dumps({
+                        "iteration": int(iteration),
+                        "derivations": int(derivations),
+                        "changed": bool(changed),
+                        "wall_s": round(time.time() - t0, 1),
+                    }) + "\n")
+
+            result = engine.saturate_observed(observer=observer)
+            rec["observed_mode"] = True
+            if first_round:
+                # ≈ observed-program compile + one superstep round; the
+                # AOT step_compile_s above measured the (unexecuted)
+                # while-loop program
+                rec["first_round_wall_s"] = first_round[0]
+        else:
+            result = engine.saturate()
         rec["exec_wall_s"] = round(time.time() - t0, 1)
         rec["iterations"] = int(result.iterations)
         rec["derivations"] = int(result.derivations)
