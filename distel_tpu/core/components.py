@@ -213,9 +213,14 @@ def partition_index(
     # * an nf1/nf3 row whose LHS is ⊤ fires on EVERY concept column
     #   (S_T[⊤] is all-ones), and one whose LHS is ⊥ fires on every
     #   unsatisfiable column — conclusions land in components that
-    #   never see the row.  (nf2/nf4 stay sound: a ⊤/⊥ operand still
-    #   leaves a live anchor premise that confines the rule's columns
-    #   to the anchor's component.)
+    #   never see the row.  (nf2/nf4 stay sound when at least ONE
+    #   operand is a live anchor premise confining the rule's columns
+    #   to the anchor's component — nf4 additionally anchors through
+    #   its role's union-find edges; an nf2 row with BOTH operands
+    #   ⊤/⊥ has no anchor and fires globally, so it is flagged below
+    #   regardless of its conclusion column.  The in-repo normalizer
+    #   never emits such a row, but partition_index accepts any
+    #   IndexedOntology — native loader, snapshots.)
     unsound = any(
         lab_vec is not None and (lab_vec < 0).any()
         for lab_vec in (row_labs["nf1"], row_labs["nf2"])
@@ -223,6 +228,11 @@ def partition_index(
     for tab in (idx.nf1, idx.nf3):
         if len(tab) and np.isin(tab[:, 0], (TOP_ID, BOTTOM_ID)).any():
             unsound = True
+    if len(idx.nf2) and (
+        np.isin(idx.nf2[:, 0], (TOP_ID, BOTTOM_ID))
+        & np.isin(idx.nf2[:, 1], (TOP_ID, BOTTOM_ID))
+    ).any():
+        unsound = True
     if unsound:
         return [Component(idx=idx, global_concepts=np.arange(2, n))]
 
@@ -470,76 +480,30 @@ def saturate_components(
     for comps in groups.values():
         rep = comps[0].idx
         B = _batch if _batch is not None else len(comps)
-        engine = RowPackedSaturationEngine(rep, **kw)
-        budget = max_iters - max_iters % engine.unroll
-
-        def run(spB, rpB, masks):
-            vstep = jax.vmap(
-                lambda sp, rp, dirty: engine._step(sp, rp, masks, None, dirty)
-            )
-
-            def cond(st):
-                return st[3] & (st[2] < budget)
-
-            def body(st):
-                spB, rpB, it, _, dirtyB = st
-                ch = jnp.zeros((spB.shape[0],), bool)
-                for _ in range(engine.unroll):
-                    spB, rpB, c, dirtyB = vstep(spB, rpB, dirtyB)
-                    ch = ch | c
-                return (spB, rpB, it + engine.unroll, jnp.any(ch), dirtyB)
-
-            spB, rpB, it, changed, _ = lax.while_loop(
-                cond,
-                body,
-                (
-                    spB,
-                    rpB,
-                    jnp.asarray(0, jnp.int32),
-                    jnp.asarray(True),
-                    jax.tree.map(
-                        lambda x: jnp.broadcast_to(
-                            x, (spB.shape[0],) + x.shape
-                        ),
-                        engine.initial_dirty(),
-                    ),
-                ),
-            )
-            bits = jax.vmap(engine._live_bits)(spB, rpB)
-            return spB, rpB, it, changed, bits
-
-        runj = jax.jit(run, donate_argnums=(0, 1))
-        zero = jnp.asarray(0, jnp.uint32)
-
-        def batch_init():
-            sp0, rp0 = engine.initial_state()
-            return (
-                jnp.broadcast_to(sp0, (B,) + sp0.shape) | zero,
-                jnp.broadcast_to(rp0, (B,) + rp0.shape) | zero,
-            )
-
-        t0 = time.time()
-        spB, rpB, it, changed, bits = runj(*batch_init(), engine._masks)
-        it, changed, bits_host = fetch_global((it, changed, bits))
-        wall = time.time() - t0  # includes the one-time jit compile
-        if bool(changed):
-            # mirror the monolithic engines' contract
-            # (engine.finish_device_run): never report a truncated
-            # closure as a result
-            raise RuntimeError(
-                f"component group (B={B}, nc={rep.n_concepts}) did not "
-                f"converge within {budget} iterations"
-            )
-        del spB, rpB
-        warm = None
-        if warm_timing:
-            # opt-in second run (the weak-scaling bench's steady-state
-            # wall); library callers pay for ONE fixed point
+        if B == 1:
+            # singleton group — including the unpartitioned fallback
+            # where the "component" is the entire corpus: run the
+            # engine's normal fixed point so the tuned auto posture
+            # (Pallas kernels, chunk gating, memory tiers) applies.
+            # The vmap pessimizations in ``kw`` exist only for true
+            # batches, where traced-cond gating and Pallas-under-vmap
+            # both pessimize.
+            engine = RowPackedSaturationEngine(rep, **(engine_kw or {}))
             t0 = time.time()
-            spB, rpB, it2, ch2, bits2 = runj(*batch_init(), engine._masks)
-            fetch_global((it2, ch2, bits2))
-            warm = time.time() - t0
-        derivs = _host_bit_total(bits_host) - B * fresh_init_total(rep)
+            res = engine.saturate(max_iters)
+            wall = time.time() - t0
+            warm = None
+            if warm_timing:
+                t0 = time.time()
+                res = engine.saturate(max_iters)
+                warm = time.time() - t0
+            it, derivs = res.iterations, int(res.derivations)
+            del res
+        else:
+            it, derivs, wall, warm = _run_group(
+                RowPackedSaturationEngine(rep, **kw),
+                rep, B, max_iters, warm_timing,
+            )
         total_derivations += int(derivs)
         total_iters_max = max(total_iters_max, int(it))
         entry = {
@@ -563,3 +527,89 @@ def saturate_components(
         "wall_warm_s": round(total_warm, 3),
         "groups": report,
     }
+
+
+def _run_group(engine, rep, B, max_iters, warm_timing):
+    """The vmapped-batch execution of one isomorphism group: B copies of
+    ``rep``'s fixed point as a leading axis over the engine's superstep.
+    Returns ``(iterations, derivations, wall_s, warm_s_or_None)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from distel_tpu.core.engine import (
+        _host_bit_total,
+        fetch_global,
+        fresh_init_total,
+    )
+
+    budget = max_iters - max_iters % engine.unroll
+
+    def run(spB, rpB, masks):
+        vstep = jax.vmap(
+            lambda sp, rp, dirty: engine._step(sp, rp, masks, None, dirty)
+        )
+
+        def cond(st):
+            return st[3] & (st[2] < budget)
+
+        def body(st):
+            spB, rpB, it, _, dirtyB = st
+            ch = jnp.zeros((spB.shape[0],), bool)
+            for _ in range(engine.unroll):
+                spB, rpB, c, dirtyB = vstep(spB, rpB, dirtyB)
+                ch = ch | c
+            return (spB, rpB, it + engine.unroll, jnp.any(ch), dirtyB)
+
+        spB, rpB, it, changed, _ = lax.while_loop(
+            cond,
+            body,
+            (
+                spB,
+                rpB,
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(True),
+                jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x, (spB.shape[0],) + x.shape
+                    ),
+                    engine.initial_dirty(),
+                ),
+            ),
+        )
+        bits = jax.vmap(engine._live_bits)(spB, rpB)
+        return spB, rpB, it, changed, bits
+
+    runj = jax.jit(run, donate_argnums=(0, 1))
+    zero = jnp.asarray(0, jnp.uint32)
+
+    def batch_init():
+        sp0, rp0 = engine.initial_state()
+        return (
+            jnp.broadcast_to(sp0, (B,) + sp0.shape) | zero,
+            jnp.broadcast_to(rp0, (B,) + rp0.shape) | zero,
+        )
+
+    t0 = time.time()
+    spB, rpB, it, changed, bits = runj(*batch_init(), engine._masks)
+    it, changed, bits_host = fetch_global((it, changed, bits))
+    wall = time.time() - t0  # includes the one-time jit compile
+    if bool(changed):
+        # mirror the monolithic engines' contract
+        # (engine.finish_device_run): never report a truncated
+        # closure as a result
+        raise RuntimeError(
+            f"component group (B={B}, nc={rep.n_concepts}) did not "
+            f"converge within {budget} iterations"
+        )
+    del spB, rpB
+    warm = None
+    if warm_timing:
+        # opt-in second run (the weak-scaling bench's steady-state
+        # wall); library callers pay for ONE fixed point
+        t0 = time.time()
+        spB, rpB, it2, ch2, bits2 = runj(*batch_init(), engine._masks)
+        fetch_global((it2, ch2, bits2))
+        warm = time.time() - t0
+    derivs = _host_bit_total(bits_host) - B * fresh_init_total(rep)
+    return int(it), int(derivs), wall, warm

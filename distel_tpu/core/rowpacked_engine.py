@@ -147,6 +147,7 @@ class RowPackedSaturationEngine:
         rules: Optional[frozenset] = None,
         mm_opts: Optional[dict] = None,
         l_chunk: Optional[int] = None,
+        l_chunk_cr4: Optional[int] = None,
         gate_chunks: Optional[bool] = None,
         min_links_pad: int = 0,
         min_concepts: int = 0,
@@ -246,8 +247,18 @@ class RowPackedSaturationEngine:
         if unroll is None:
             # second tier: past ~4.8 GB of per-shard state the second
             # unrolled body's live chunk buffers alone break one chip
-            # (112k many-role: 12.35 GB at unroll=1 vs 15.96 GB at 2)
-            unroll = 1 if state_bytes > (9 << 29) else 2
+            # (112k many-role: 12.35 GB at unroll=1 vs 15.96 GB at 2).
+            # Mesh engines drop to 1 already at the `large` threshold:
+            # there the per-step vote the second body amortizes is noise
+            # next to the step itself, while the doubled traced body is
+            # one of the biggest factors in the XLA compile wall (the
+            # SNOMED-scale shapes are mesh-only, and their compile is
+            # the deploy-time cost the reference never pays —
+            # ``scripts/run-all.sh`` relaunches in minutes)
+            if mesh is not None and large:
+                unroll = 1
+            else:
+                unroll = 1 if state_bytes > (9 << 29) else 2
         self.unroll = max(int(unroll), 1)
         if temp_budget_bytes is None:
             if tier3:
@@ -488,6 +499,26 @@ class RowPackedSaturationEngine:
         lc = _pad_up(-(-self.nl // self.n_lchunks), 32)
         self.nl = self.n_lchunks * lc
         self.lc = lc
+        # CR4 gets its OWN (finer) window length: its per-chunk live
+        # link runs are much shorter than CR6's (one existential's role
+        # vs a chain head's whole subrole closure), so windows quantized
+        # at the CR6-sized lc overshoot badly — measured slack at the
+        # 96k many-role shape: 1.63x at lc=1600 vs 1.10x at lc=800
+        # (CR6: 1.17x vs 1.06x, but finer CR6 windows double the
+        # accumulator read-modify-write traffic of its much larger row
+        # chunks, a bad trade).  The window table maps its c01 entries
+        # onto the GLOBAL lc grid, so the L-frontier granularity is
+        # unchanged.  lc4 clamps to lc: the c01 table records only a
+        # window's FIRST and LAST global-lc chunk, which covers every
+        # overlapped chunk only while the window is no wider than one
+        # chunk — a coarser window could straddle a middle chunk whose
+        # dirtiness would then never re-activate it (missed derivations
+        # with a clean convergence vote).
+        if l_chunk_cr4 is None:
+            lc4 = lc
+        else:
+            lc4 = min(_pad_up(max(l_chunk_cr4, 32), 32), lc)
+        self.lc4 = lc4
 
         # ---- word-block sweep plan for CR1-CR3 + CR5: the block width
         # bounds each rule's gather/reduce temporaries (the widest live
@@ -576,15 +607,17 @@ class RowPackedSaturationEngine:
         # are 0, so they contribute nothing (and windows clamped at the
         # link-table tail re-derive earlier links — OR is idempotent).
         # Chunks with NO relevant links are dropped outright.
-        def live_windows(role_list):
+        def live_windows(role_list, lcn):
             """Static live L-window offsets (offs, c01) for a row span
             whose axiom roles are ``role_list`` — shared by the per-chunk
             and the scanned-slab builders; None when no link can satisfy
-            the span's roles.  ``c01`` holds the aligned dirty_l chunks a
-            window overlaps (≤ 2); the filler/link-role window contents
-            are dynamic slices of the SHARED [nl] tables at runtime —
-            stacking copies here would replicate them up to n_chunks
-            times in the jitted-run arguments."""
+            the span's roles.  ``lcn`` is the rule's window length (CR4
+            may run finer windows than the global ``lc``).  ``c01`` holds
+            the aligned GLOBAL-lc dirty_l chunks a window overlaps; the
+            filler/link-role window contents are dynamic slices of the
+            SHARED [nl] tables at runtime — stacking copies here would
+            replicate them up to n_chunks times in the jitted-run
+            arguments."""
             croles = np.unique(role_list)
             rel = np.flatnonzero(h[:, croles].any(axis=1))
             live = np.flatnonzero(np.isin(self._link_roles, rel))
@@ -593,7 +626,6 @@ class RowPackedSaturationEngine:
                 live = live[(live >= w0) & (live < w1)]
             if live.size == 0:
                 return None
-            lcn = self.lc
             offs = []
             i = 0
             while i < live.size:
@@ -603,27 +635,27 @@ class RowPackedSaturationEngine:
             offs = np.asarray(offs, np.int32)
             c01 = np.stack(
                 [
-                    offs // lcn,
+                    offs // self.lc,
                     np.minimum(
-                        (offs + lcn - 1) // lcn, self.n_lchunks - 1
+                        (offs + lcn - 1) // self.lc, self.n_lchunks - 1
                     ),
                 ],
                 axis=1,
             ).astype(np.int32)
             return offs, c01
 
-        def build_tiles(chunks, role_of):
+        def build_tiles(chunks, role_of, lcn):
             kept, tiles = [], []
             for raw, inv, piece in chunks:
-                win = live_windows(role_of(raw))
+                win = live_windows(role_of(raw), lcn)
                 if win is None:
                     continue
                 kept.append((raw, inv, piece))
                 tiles.append((jnp.asarray(win[0]), jnp.asarray(win[1])))
             return kept, tiles
 
-        def build_scan(rk, tab_roles, rows_src, tab_targets, mask_tab,
-                       fd_idx, fd_pad, want_readers=True):
+        def build_scan(rk, lcn, tab_roles, rows_src, tab_targets,
+                       mask_tab, fd_idx, fd_pad, want_readers=True):
             """Uniform padded chunk slabs for one rule's scanned
             contraction: the role-sorted table splits into spans of
             exactly ``rk`` rows (tail zero-padded — padded rows have
@@ -644,7 +676,7 @@ class RowPackedSaturationEngine:
             rows_l, fdx_l, m_l = [], [], []
             offs_l, c01_l, tgt_l, reader_rows = [], [], [], []
             for a0, a1 in spans:
-                win = live_windows(tab_roles[a0:a1])
+                win = live_windows(tab_roles[a0:a1], lcn)
                 if win is None:
                     continue
                 pad = rk - (a1 - a0)
@@ -709,6 +741,7 @@ class RowPackedSaturationEngine:
             )
             return {
                 "rk": rk,
+                "lcn": lcn,
                 "nch": nch,
                 "T": T,
                 "groups": groups,
@@ -724,15 +757,15 @@ class RowPackedSaturationEngine:
             rk4, rk6 = self._scan_rk
             self._scan4 = (
                 build_scan(
-                    rk4, idx.nf4[:, 0], self._a4, idx.nf4[:, 2], m4,
-                    self._a4, self.nc,
+                    rk4, self.lc4, idx.nf4[:, 0], self._a4,
+                    idx.nf4[:, 2], m4, self._a4, self.nc,
                 )
                 if self._has4
                 else None
             )
             self._scan6 = (
                 build_scan(
-                    rk6, idx.chain_pairs[:, 0], self._l26,
+                    rk6, self.lc, idx.chain_pairs[:, 0], self._l26,
                     idx.chain_pairs[:, 2], m6,
                     self._l26 // self.lc, self.n_lchunks,
                     want_readers=False,
@@ -750,10 +783,11 @@ class RowPackedSaturationEngine:
         else:
             self._scan4 = self._scan6 = None
             self._cr4_chunks, self._cr4_tiles = build_tiles(
-                self._cr4_chunks, lambda raw: idx.nf4[raw, 0]
+                self._cr4_chunks, lambda raw: idx.nf4[raw, 0], self.lc4
             )
             self._cr6_chunks, self._cr6_tiles = build_tiles(
-                self._cr6_chunks, lambda raw: idx.chain_pairs[raw, 0]
+                self._cr6_chunks, lambda raw: idx.chain_pairs[raw, 0],
+                self.lc,
             )
             self._masks = (
                 jnp.asarray(m4),
@@ -777,24 +811,26 @@ class RowPackedSaturationEngine:
         wl = self.wc // self.n_shards
         if self._scan_mode:
 
-            def scan_mm(rk):
+            def scan_mm(rk, lcn):
                 # the ONE plan all scanned chunks share; under the XLA
                 # fallback the m-axis pad is pure wasted MACs, so align
                 # it to 8 instead of the Pallas grid tile
                 kw2 = dict(mm_kw)
                 if kw2.get("use_xla") and "tm" not in kw2:
                     kw2["tm"] = max(_pad_up(rk, 8), 8)
-                return PackedColsMatmulPlan(rk, lc, wl, **kw2)
+                return PackedColsMatmulPlan(rk, lcn, wl, **kw2)
 
             self._cr4_mm = (
-                [scan_mm(self._scan_rk[0])] if self._scan4 else []
+                [scan_mm(self._scan_rk[0], self.lc4)]
+                if self._scan4
+                else []
             )
             self._cr6_mm = (
-                [scan_mm(self._scan_rk[1])] if self._scan6 else []
+                [scan_mm(self._scan_rk[1], lc)] if self._scan6 else []
             )
         else:
             self._cr4_mm = [
-                PackedColsMatmulPlan(len(raw), lc, wl, **mm_kw)
+                PackedColsMatmulPlan(len(raw), self.lc4, wl, **mm_kw)
                 for raw, _, _ in self._cr4_chunks
             ]
             self._cr6_mm = [
@@ -1194,27 +1230,27 @@ class RowPackedSaturationEngine:
             rw += 2 * (self.nc + self.nl) * w4
         macs = 0
         live_macs = 0
-        for chunks, tiles in (
-            (self._cr4_chunks, self._cr4_tiles),
-            (self._cr6_chunks, self._cr6_tiles),
+        for chunks, tiles, lcn in (
+            (self._cr4_chunks, self._cr4_tiles, self.lc4),
+            (self._cr6_chunks, self._cr6_tiles, self.lc),
         ):
             for (raw, _inv, piece), tile in zip(chunks, tiles):
                 n_t = int(tile[0].shape[0])
-                rw += n_t * self.lc * w4                 # live R windows
+                rw += n_t * lcn * w4                     # live R windows
                 rw += len(raw) * w4                      # subt gather
                 rw += 2 * piece.n_targets * w4           # target RMW
                 macs += len(raw) * self.nl * self.nc
-                live_macs += len(raw) * n_t * self.lc * self.nc
+                live_macs += len(raw) * n_t * lcn * self.nc
         for d in (self._scan4, self._scan6):
             if d is None:
                 continue
-            rk = d["rk"]
+            rk, lcn = d["rk"], d["lcn"]
             n_t_total = int(d["n_windows"].sum())
             # every chunk executes T = max(n_windows) slots; padded
             # slots still issue their R-window dynamic_slice read (only
             # the MXU work is zeroed), so the traffic bound charges the
             # padded plane, not just the live windows
-            rw += d["nch"] * d["T"] * self.lc * w4       # R window reads
+            rw += d["nch"] * d["T"] * lcn * w4           # R window reads
             rw += d["nch"] * rk * w4                     # subt gathers
             # deferred per-group output buffers: one write + the
             # emission-order re-gather on top of the target RMW
@@ -1222,7 +1258,7 @@ class RowPackedSaturationEngine:
                 rw += 2 * plan.n_targets * w4
                 rw += 2 * plan.k * w4
             macs += d["nch"] * rk * self.nl * self.nc
-            live_macs += n_t_total * rk * self.lc * self.nc
+            live_macs += n_t_total * rk * lcn * self.nc
         if self._bottom:
             rw += (self.nl + 2) * w4
         return {
@@ -1435,20 +1471,22 @@ class RowPackedSaturationEngine:
             else lax.axis_index(axis_name) * (self.wc // self.n_shards)
         )
 
-        def window_term(subt, rp_state, off, live, mask_rows, mm):
+        def window_term(subt, rp_state, off, live, mask_rows, mm, lcw):
             """One live L-window's contribution to a CR4/CR6 chunk: the
             [rk, wlw] packed AND-OR product of the (factored-mask ∧
             bit-table ∧ ``live``) operand against the window's R rows.
-            ``live`` zeroes the operand when nothing the window reads
-            changed last step — OR-monotone, so skipping only delays;
-            the Pallas kernel's per-tile skip flags then drop the MXU
-            work.  Shared verbatim by the unrolled and scanned
-            formulations (tests/test_scan_engine.py pins them
-            bit-identical).  Window contents slice the SHARED
-            filler/link-role tables (stacked per-chunk copies would
-            replicate them ×n_chunks in the run arguments)."""
-            fcols = lax.dynamic_slice(fills, (off,), (lc,))
-            lrole = lax.dynamic_slice(lroles, (off,), (lc,))
+            ``lcw`` is the rule's window length (CR4 may run finer
+            windows than CR6 — see ``lc4`` in ``__init__``).  ``live``
+            zeroes the operand when nothing the window reads changed
+            last step — OR-monotone, so skipping only delays; the Pallas
+            kernel's per-tile skip flags then drop the MXU work.  Shared
+            verbatim by the unrolled and scanned formulations
+            (tests/test_scan_engine.py pins them bit-identical).  Window
+            contents slice the SHARED filler/link-role tables (stacked
+            per-chunk copies would replicate them ×n_chunks in the run
+            arguments)."""
+            fcols = lax.dynamic_slice(fills, (off,), (lcw,))
+            lrole = lax.dynamic_slice(lroles, (off,), (lcw,))
             with jax.named_scope("bit_table"):
                 if axis_name is None:
                     f = bit_lookup_from(subt, fcols, dtype=dt)
@@ -1466,11 +1504,12 @@ class RowPackedSaturationEngine:
                 * f.T
                 * live.astype(dt)
             )
-            b = lax.dynamic_slice(rp_state, (off, 0), (lc, wlw))
+            b = lax.dynamic_slice(rp_state, (off, 0), (lcw, wlw))
             return mm(w, b)
 
         def contract_from(
-            bits_state, rp_state, rows, mask_rows, mm, f_dirty, tiles
+            bits_state, rp_state, rows, mask_rows, mm, f_dirty, tiles,
+            lcw,
         ):
             """``f_dirty``: scalar — did any bit-table SOURCE row of this
             chunk change last step?  A live window whose R slice is also
@@ -1488,7 +1527,7 @@ class RowPackedSaturationEngine:
             def one(i, acc):
                 live = dirty_l[c01[i, 0]] | dirty_l[c01[i, 1]] | f_dirty
                 return acc | window_term(
-                    subt, rp_state, offs[i], live, mask_rows, mm
+                    subt, rp_state, offs[i], live, mask_rows, mm, lcw
                 )
 
             if n_t == 1:
@@ -1523,7 +1562,8 @@ class RowPackedSaturationEngine:
                             | fd_k
                         )
                         return acc | window_term(
-                            subt, rp_state, offs_k[i], live, m_k, mm
+                            subt, rp_state, offs_k[i], live, m_k, mm,
+                            d["lcn"],
                         )
 
                     z = jnp.zeros((rk, wlw), jnp.uint32)
@@ -1599,7 +1639,8 @@ class RowPackedSaturationEngine:
                         else jnp.asarray(False)
                     )
                     out = contract_from(
-                        s, r, self._a4[raw], m4[raw], mm, f_dirty, tiles
+                        s, r, self._a4[raw], m4[raw], mm, f_dirty,
+                        tiles, self.lc4,
                     )
                     return plan.reduce(out[inv])
 
@@ -1626,7 +1667,8 @@ class RowPackedSaturationEngine:
                         else jnp.asarray(False)
                     )
                     out = contract_from(
-                        r, r, self._l26[raw], m6[raw], mm, f_dirty, tiles
+                        r, r, self._l26[raw], m6[raw], mm, f_dirty,
+                        tiles, self.lc,
                     )
                     return plan.reduce(out[inv])
 

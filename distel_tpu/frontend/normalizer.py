@@ -106,7 +106,17 @@ class NormalizedOntology:
 
 
 class Normalizer:
-    def __init__(self, cache: Optional[Dict[str, str]] = None):
+    def __init__(
+        self,
+        cache: Optional[Dict[str, str]] = None,
+        range_state: Optional[tuple] = None,
+    ):
+        """``range_state``: ``(ranges, role_edges)`` carried from earlier
+        increments (``export_range_state``) so a NEW batch's existentials
+        see ranges declared in OLD batches — the reference applies ranges
+        at runtime per link insert (``RolePairHandler.java:380-444``),
+        which is naturally cross-increment; here the rewrite happens at
+        normalize time, so the state must be threaded explicitly."""
         self.out = NormalizedOntology()
         self._gensym_counter = 0
         #: direction-aware memo: (expr-str, 'lhs'|'rhs') → gensym Class.
@@ -126,6 +136,11 @@ class Normalizer:
         self._role_edges: List[Tuple[S.ObjectProperty, S.ObjectProperty]] = []
         self._range_memo: Dict[Tuple[Atom, FrozenSet[Atom]], S.Class] = {}
         self._super_closure: Dict[S.ObjectProperty, set] = {}
+        if range_state is not None:
+            ranges, edges = range_state
+            for role, rs in ranges.items():
+                self._ranges.setdefault(role, set()).update(rs)
+            self._role_edges.extend(edges)
 
     # ------------------------------------------------------------------ API
 
@@ -158,6 +173,56 @@ class Normalizer:
     def export_cache(self) -> Dict[str, str]:
         """Persistable gensym cache (parity with the Redis NORMALIZE_CACHE)."""
         return {f"{k[0]}\x00{k[1]}": v.iri for k, v in self._memo.items()}
+
+    def export_range_state(self) -> tuple:
+        """Carry-over counterpart of :meth:`export_cache` for the range
+        machinery: ``(ranges, role_edges)`` to seed the NEXT increment's
+        Normalizer (see ``__init__``)."""
+        return (
+            {r: set(v) for r, v in self._ranges.items()},
+            list(self._role_edges),
+        )
+
+    def effective_ranges(self, role: S.ObjectProperty) -> FrozenSet[Atom]:
+        """R*(role): the ranges of every super-role over the plain-
+        hierarchy closure (the set ``_apply_range_rewrite`` conjoins),
+        minus ⊤.  Only meaningful after :meth:`normalize` has built the
+        closure."""
+        out: set = set()
+        for sup in self._super_closure.get(role, {role}):
+            out.update(self._ranges.get(sup, ()))
+        out.discard(S.OWL_THING)
+        return frozenset(out)
+
+    def retrofit_ranges(self, old_nf3, old_effective: Dict) -> int:
+        """Re-apply range elimination to nf3 rows normalized in EARLIER
+        increments whose effective range set has since GROWN (a later
+        batch added Range(s, D) with s ⊒ r, or a hierarchy edge under a
+        range-bearing role).  Append-only: for each affected old row
+        A ⊑ ∃r.F this emits A ⊑ ∃r.X, X ⊑ F, X ⊑ D into THIS batch's
+        output — the old row stays (sound: its consequences remain
+        entailed) and the new row carries the range conjunct, exactly
+        the reference's runtime re-emit on live stores
+        (``RolePairHandler.java:380-444``).  Returns the number of rows
+        retrofitted.  Call after :meth:`normalize`."""
+        if not self._ranges:
+            # range-free workloads (the common case) skip the
+            # O(|accumulated nf3|) walk entirely: effective sets are
+            # monotone, so no current ranges ⇒ none before either
+            return 0
+        changed: Dict[S.ObjectProperty, bool] = {}
+        n = 0
+        for a, role, f in old_nf3:
+            if role not in changed:
+                changed[role] = self.effective_ranges(
+                    role
+                ) != old_effective.get(role, frozenset())
+            if changed[role]:
+                x = self._apply_range_rewrite(role, f)
+                if x is not f:
+                    self.out.nf3.append((a, role, x))
+                    n += 1
+        return n
 
     def save_cache(self, path: str) -> None:
         with open(path, "w") as f:
@@ -354,7 +419,26 @@ class Normalizer:
         hit = self._range_memo.get(key)
         if hit is not None:
             return hit
+        # persistable twin of ``key``: range gensyms must enter the SAME
+        # exported cache as every other gensym — an unexported name lets
+        # the next increment's restored counter re-mint it for a
+        # DIFFERENT concept, silently merging the two (unsound).  A
+        # cache hit (cross-process restore) reuses the name without
+        # re-emitting its defining rows, like ``_flatten_rhs``: the
+        # cache contract is that the rows live in the accumulated
+        # corpus the cache came from.
+        ckey = (
+            expr_to_str(b)
+            + "\x01"
+            + ",".join(sorted(expr_to_str(d) for d in ranges)),
+            "range",
+        )
+        x = self._memo.get(ckey)
+        if x is not None:
+            self._range_memo[key] = x
+            return x
         x = self._gensym(f"range({role.iri},{expr_to_str(b)})")
+        self._memo[ckey] = x
         self._range_memo[key] = x
         if b is not S.OWL_THING:
             self.out.nf1.append((x, b))

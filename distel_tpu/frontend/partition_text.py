@@ -33,9 +33,17 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
-#: entity tokens: IRIs in <>, prefixed names, or bare NCNames — anything
-#: that is not an OFN keyword/punctuation
-_TOKEN = re.compile(r"<[^>]*>|[A-Za-z_][\w\-.:#/]*")
+#: entity tokens: IRIs in <>, prefixed names (incl. default-prefix
+#: ``:A`` — without the optional leading colon, ``:A`` and a bare ``A``
+#: would intern as the same union-find node and silently coarsen the
+#: partition; advisor r3 item 3), or bare NCNames — anything that is
+#: not an OFN keyword/punctuation
+_TOKEN = re.compile(r"<[^>]*>|:?[A-Za-z_][\w\-.:#/]*")
+#: string literals: their contents must not create interaction-graph
+#: edges (a literal that happens to spell an entity name would glue
+#: unrelated components).  Canonicalization still renames literal
+#: tokens — sound, because closures are equivariant under renaming.
+_LITERAL = re.compile(r'"(?:[^"\\]|\\.)*"')
 _KEYWORDS = frozenset(
     (
         "SubClassOf", "EquivalentClasses", "DisjointClasses",
@@ -85,7 +93,7 @@ _LOGICAL = frozenset(
 
 def _line_entities(line: str) -> List[str]:
     out = []
-    for tok in _TOKEN.findall(line):
+    for tok in _TOKEN.findall(_LITERAL.sub('""', line)):
         if tok in _KEYWORDS:
             continue
         out.append(tok)

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Assemble SCALE_r04.json from the round's probe lines + measured
+experiment logs.  Idempotent: re-run after each new probe lands."""
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+lines = []
+p = os.path.join(_REPO, "SCALE_r04_probes.jsonl")
+if os.path.exists(p):
+    seen = set()
+    for ln in open(p):
+        ln = ln.strip()
+        if ln and ln not in seen:
+            seen.add(ln)
+            lines.append(json.loads(ln))
+
+out = {
+    "what": (
+        "r4 SNOMED-scale story: the scanned uniform-chunk compile lever "
+        "(O(1) traced program in chunk count), the 300k memory row "
+        "re-measured under the tier-3+scan posture, the >=128k sharded "
+        "execution recorded with a durable per-superstep progress file, "
+        "a component-partitioned many-role 300k-class execution, and "
+        "the 96k window/tile slack experiments"
+    ),
+}
+
+for rec in lines:
+    if rec.get("n_classes") == 300000 and rec.get("devices") == 8 and "step_compile_s" in rec:
+        out["sharded_probe_300k_tier3_scan"] = dict(
+            rec,
+            note=(
+                "measured under the r4 posture: mesh tier-3 (64 MB chunk "
+                "budget, serialized chunks) + scanned uniform chunks. "
+                "r3 measured 29.85 GB/shard temp under the stale tier-2 "
+                "posture; the v4-8 fit claim is now MEASUREMENT: live = "
+                "temp+args (args alias outputs under donation) = 9.67 "
+                "GB/shard virtual ~ 11 GB real at the ~1.15x calibration "
+                "- fits v4-8 (32 GB) and v5e-8 (16 GB). Compile wall "
+                "measured on ONE CPU core CONTENDED by the 128k "
+                "execution (load ~19): upper bound"
+            ),
+        )
+    if rec.get("shape") == "galen" and rec.get("n_classes") == 128000 and rec.get("iterations"):
+        out["executed_sharded_galen_128k"] = dict(
+            rec,
+            note=(
+                "r3's unfinished run completed and RECORDED: 8-device "
+                "virtual CPU mesh execution of the 3-role 128k-class "
+                "corpus; target pre-measured single-device on the real "
+                "chip was 20 iterations / 5,201,685 derivations / "
+                "converged"
+            ),
+        )
+    if rec.get("what", "").startswith("component-partitioned"):
+        out["executed_300k_component_partitioned"] = rec
+
+w96 = {}
+for log, keymap in (
+    ("bench96_lc4.log", None),
+    ("bench96_round2.log", None),
+):
+    lp = os.path.join(_REPO, log)
+    if not os.path.exists(lp):
+        continue
+    for ln in open(lp):
+        ln = ln.strip()
+        if ln.startswith("{") and not ln.startswith('{"FINAL'):
+            try:
+                w96.update(json.loads(ln))
+            except ValueError:
+                pass
+if w96:
+    out["slack_experiments_96k"] = {
+        "variants": w96,
+        "reading": (
+            "the 96k warm wall is flat (17.1-17.8 s) across CR4 window "
+            "lengths 512/800/1600 and a 3200 global window; tm=1024 "
+            "Pallas tiles crash the remote compile helper. The static "
+            "CR4 window slack (1.63x at lc=1600 vs 1.10x at 800, "
+            "measured host-side) is recovered at RUNTIME by the "
+            "kernel's zero-tile skip, so it is NOT claimable wall time "
+            "- the r3 floor analysis's 'fewer MACs' direction is "
+            "measured irreducible at the schedule level; the remaining "
+            "2.7 s over the ~14.5 s bf16 floor is the Mosaic kernel's "
+            "15% utilization gap + non-MM sweeps (r3 mm_floor_analysis)"
+        ),
+    }
+
+path = os.path.join(_REPO, "SCALE_r04.json")
+with open(path, "w") as f:
+    json.dump(out, f, indent=1)
+print("wrote", path, "with", sorted(out.keys()))

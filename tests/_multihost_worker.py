@@ -32,13 +32,25 @@ text = snomed_shaped_ontology(n_classes=n_classes, n_roles=24)
 idx = index_ontology(normalize(parser.parse(text)))
 engine = RowPackedSaturationEngine(idx, mesh=mesh)
 res = engine.saturate()  # cold: compile + run
+
+
+def _best_of_2(f):
+    """Best-of-2 warm wall: the host shares ONE physical core between
+    both worker processes, so a single sample can absorb a scheduler
+    stall and flake the overhead bound (advisor r3 item 1)."""
+    walls = []
+    for _ in range(2):
+        t0 = time.time()
+        out = f()
+        walls.append(time.time() - t0)
+    return out, min(walls)
+
+
 # warm wall of the distributed fixed point — the number that makes the
 # cross-process (DCN-analog) overhead visible next to the single-process
 # wall printed by pid 0 below (reference scale story:
 # scripts/classify-all.sh pssh fan-out)
-t0 = time.time()
-res = engine.saturate()
-mesh_warm_s = time.time() - t0
+res, mesh_warm_s = _best_of_2(engine.saturate)
 
 # full-closure comparison, not just counts: res.s goes through the
 # collective allgather fetch (every process participates), and proc 0
@@ -53,9 +65,7 @@ local_warm_s = -1.0
 if pid == 0:
     local_engine = RowPackedSaturationEngine(idx)
     local = local_engine.saturate()
-    t0 = time.time()
-    local = local_engine.saturate()
-    local_warm_s = time.time() - t0
+    local, local_warm_s = _best_of_2(local_engine.saturate)
     closure_match = bool(
         local.derivations == res.derivations
         and local.s[:n, :n].tobytes() == mesh_closure[0]

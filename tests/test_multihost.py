@@ -79,18 +79,19 @@ def test_two_process_mesh_matches_single_process():
         for ln in lines
     ), lines
     # BOUNDED overhead, not just printed (r2 verdict item 7): the mesh
-    # wall must stay within 12x the single-process wall.  Measured
-    # margin on this host class: 11.0 s vs 1.6 s (~7x) — both runs
-    # share ONE physical core here, so the mesh pays 2-process gloo
-    # serialization + 8 virtual devices' program overhead on top of the
-    # same total compute; 12x holds that with headroom while failing
-    # the order-of-magnitude blowup a collectives-dominated regression
-    # (e.g. a per-chunk psum) produces.
+    # wall must stay within 9x the single-process wall.  Measured
+    # margin on this host class: ~7x — both runs share ONE physical
+    # core here, so the mesh pays 2-process gloo serialization + 8
+    # virtual devices' program overhead on top of the same total
+    # compute; 9x holds that with modest headroom (walls are best-of-2
+    # per side, so a single scheduler stall cannot flake the bound)
+    # while failing a ~1.5x collectives regression (e.g. a per-chunk
+    # psum), not just an order-of-magnitude blowup.
     walls = lines[0] if "local_warm_s=-1.00" not in lines[0] else lines[1]
     mesh_s = float(walls.split("mesh_warm_s=")[1].split()[0])
     local_s = float(walls.split("local_warm_s=")[1].split()[0])
-    assert mesh_s <= 12 * local_s, (
-        f"mesh {mesh_s:.2f}s > 12x single-process {local_s:.2f}s — "
+    assert mesh_s <= 9 * local_s, (
+        f"mesh {mesh_s:.2f}s > 9x single-process {local_s:.2f}s — "
         "collective overhead regression"
     )
     print("\n".join(lines))

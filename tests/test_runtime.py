@@ -715,7 +715,9 @@ def test_incremental_delta_fast_path_matches_batch():
         "SubClassOf(ObjectIntersectionOf(Find3 Find5) ExtraBoth)\n"
         "DisjointClasses(Extra2 Find3)\nSubClassOf(Extra2 Find3)\n"
     )
-    # link-creating delta: a fresh role forces the full rebuild
+    # link-creating delta with a FRESH role: since r4 this stays on the
+    # fast path too — the new role's links park in the reserved link
+    # rows where the base program's sentinel-role tables keep them inert
     delta2 = "SubClassOf(Extra3 ObjectSomeValuesFrom(brandNewRole Find9))\n"
 
     inc = IncrementalClassifier()
@@ -727,7 +729,7 @@ def test_incremental_delta_fast_path_matches_batch():
     assert inc._base_engine is base_engine  # fast path: no rebuild
     assert r1.derivations > 0
     r2 = inc.add_text(delta2)
-    assert inc._base_engine is not base_engine  # rebuilt (new link)
+    assert inc._base_engine is base_engine  # fast path: new role parked
 
     # the final closure must equal a cold batch run, name for name
     batch_idx = index_ontology(normalize(parser.parse(base + delta1 + delta2)))
@@ -964,3 +966,181 @@ def test_incremental_link_delta_overflowing_pad_rebuilds():
         if i < r.idx.n_concepts
     }
     assert "L7" in names
+
+
+def test_incremental_role_delta_new_subrole_fast_path():
+    """A delta introducing a NEW role as a subrole of an existing one —
+    with links and an ∃-on-the-left axiom over it — stays on the fast
+    path (r4: role-adding deltas; reference parity with T4 inserts,
+    ``init/AxiomLoader.java:1051-1132``) and matches the batch closure:
+    the new role's links park in the reserved rows, the delta program
+    carries the new rows under the NEW closure, and the cross program
+    joins the old ∃-axioms (via the superrole) against the new links."""
+    base = (
+        "SubClassOf(ObjectSomeValuesFrom(oldR OldFiller) SuperHit)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(oldR PadFiller))\n"
+        "SubClassOf(OldFiller OFSup)\n"
+    )
+    delta = (
+        "SubObjectPropertyOf(newR oldR)\n"
+        "SubClassOf(X ObjectSomeValuesFrom(newR OldFiller))\n"
+        "SubClassOf(ObjectSomeValuesFrom(newR OldFiller) NewHit)\n"
+    )
+    sups = _inc_vs_batch(base, delta, ["X", "Pad"])
+    # via newR ⊑ oldR the old axiom fires on the NEW link (cross
+    # program), and the delta's own ∃-axiom fires on it too (B program)
+    assert {"SuperHit", "NewHit"} <= sups["X"]
+    # the old link must NOT satisfy the newR-restricted axiom
+    assert "NewHit" not in sups["Pad"]
+
+
+def test_incremental_role_delta_new_superrole_fast_path():
+    """A new role ABOVE an existing one (oldR ⊑ newR): the restricted
+    closure over old roles is unchanged, so the fast path holds, and the
+    delta's ∃newR-axiom must fire on OLD links through the new closure
+    (delta program over all links)."""
+    base = (
+        "SubClassOf(A ObjectSomeValuesFrom(oldR B))\n"
+        "SubClassOf(B BSup)\n"
+    )
+    delta = (
+        "SubObjectPropertyOf(oldR newR)\n"
+        "SubClassOf(ObjectSomeValuesFrom(newR B) UpHit)\n"
+    )
+    sups = _inc_vs_batch(base, delta, ["A"])
+    assert "UpHit" in sups["A"]
+
+
+def test_incremental_role_delta_new_chain_fast_path():
+    """A delta adding a new role plus a CHAIN through it: the indexer
+    derives the new chain pairs at re-index; the closure restricted to
+    old roles is unchanged, so the fast path holds and the chain
+    consequence must appear exactly as in the batch run."""
+    base = (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(t D) ChainHit)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(t PadF))\n"  # t has a link
+        "SubClassOf(B BSup)\n"
+    )
+    delta = (
+        "SubObjectPropertyOf(ObjectPropertyChain(r newS) t)\n"
+        "SubClassOf(B ObjectSomeValuesFrom(newS D))\n"
+    )
+    sups = _inc_vs_batch(base, delta, ["A", "B"])
+    assert "ChainHit" in sups["A"]
+
+
+def test_incremental_role_delta_hierarchy_change_rebuilds():
+    """A delta that changes the closure between EXISTING roles (r ⊑ s
+    added) must take the rebuild path — the base program's baked
+    factored masks would under-derive on old links — and still match
+    the batch closure."""
+    base = (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(s B) SHit)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(s PadF))\n"
+        "SubClassOf(B BSup)\n"
+    )
+    delta = "SubObjectPropertyOf(r s)\n"
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    r = inc.add_text(delta)
+    assert inc._base_engine is not base_engine, "expected a rebuild"
+    names = {
+        r.idx.concept_names[i]
+        for i in r.subsumers(r.idx.concept_ids["A"])
+        if i < r.idx.n_concepts
+    }
+    assert "SHit" in names
+
+
+def test_incremental_role_delta_old_pair_through_new_role_rebuilds():
+    """r ⊑ new ⊑ s introduces a NEW old→old closure pair THROUGH the
+    new role: the restricted-closure check must catch it and rebuild
+    (the base program's masks for s-axioms don't cover r-links)."""
+    base = (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(s B) SHit)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(s PadF))\n"
+    )
+    delta = (
+        "SubObjectPropertyOf(r newMid)\n"
+        "SubObjectPropertyOf(newMid s)\n"
+    )
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    r = inc.add_text(delta)
+    assert inc._base_engine is not base_engine, "expected a rebuild"
+    names = {
+        r.idx.concept_names[i]
+        for i in r.subsumers(r.idx.concept_ids["A"])
+        if i < r.idx.n_concepts
+    }
+    assert "SHit" in names
+
+
+def test_incremental_range_applies_to_later_batch():
+    """A range declared in the BASE must rewrite existentials normalized
+    in a LATER batch — the range state is carried across increments
+    (reference: runtime range re-emit is naturally cross-increment,
+    ``RolePairHandler.java:380-444``)."""
+    base = (
+        "ObjectPropertyRange(r RangeD)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(r PadF))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r RangeD) RHit)\n"
+    )
+    delta = "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+    sups = _inc_vs_batch(base, delta, ["A", "Pad"], expect_fast=False)
+    assert "RHit" in sups["A"]
+
+
+def test_incremental_late_range_retrofits_old_rows():
+    """A range declared in a LATER batch must reach existentials
+    normalized in EARLIER batches: the retrofit appends the rewritten
+    rows (old rows stay — sound under monotonicity) and the closure
+    must equal the batch run's."""
+    base = (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r RangeD) RHit)\n"
+        "SubClassOf(B BSup)\n"
+    )
+    delta = "ObjectPropertyRange(r RangeD)\n"
+    sups = _inc_vs_batch(base, delta, ["A"], expect_fast=False)
+    assert "RHit" in sups["A"]
+
+
+def test_incremental_late_range_via_new_hierarchy_edge():
+    """A later batch that links an existing role under a range-bearing
+    superrole grows the subrole's EFFECTIVE range set — the retrofit
+    must key on effective sets, not declared ones.  (The hierarchy
+    change forces the rebuild path; completeness must survive it.)"""
+    base = (
+        "ObjectPropertyRange(s RangeD)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(s PadF))\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r RangeD) RHit)\n"
+    )
+    delta = "SubObjectPropertyOf(r s)\n"
+    sups = _inc_vs_batch(base, delta, ["A"], expect_fast=False)
+    assert "RHit" in sups["A"]
+
+
+def test_incremental_range_gensym_no_cross_batch_collision():
+    """Range-rewrite gensyms must round-trip through the exported cache:
+    if increment 1's range gensym is not recorded, increment 2's
+    restored counter re-mints the same name for a DIFFERENT concept and
+    the two definitions merge — an unsound closure (A would inherit
+    PadHit through the shared name)."""
+    base = (
+        "ObjectPropertyRange(r RangeD)\n"
+        "SubClassOf(Pad ObjectSomeValuesFrom(r PadF))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r PadF) PadHit)\n"
+    )
+    delta = "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+    sups = _inc_vs_batch(base, delta, ["A", "Pad"], expect_fast=False)
+    assert "PadHit" not in sups["A"], "gensym collision merged concepts"
+    assert "PadHit" in sups["Pad"]

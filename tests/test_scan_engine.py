@@ -125,3 +125,14 @@ def test_scan_auto_threshold():
     assert (
         forced.saturate().derivations == auto.saturate().derivations
     )
+
+
+def test_lc4_clamps_to_global_window():
+    # a CR4 window wider than the global lc could straddle a middle
+    # dirty_l chunk that its 2-entry c01 record cannot see — the engine
+    # must clamp rather than silently under-derive
+    idx = index_ontology(
+        normalize(parser.parse(snomed_shaped_ontology(n_classes=800)))
+    )
+    eng = RowPackedSaturationEngine(idx, l_chunk_cr4=1 << 20)
+    assert eng.lc4 <= eng.lc
