@@ -219,12 +219,6 @@ def main() -> None:
         extra["incremental_delta_axioms"] = 100
         extra["incremental_delta_new_derivations"] = dres.derivations
 
-        # rebuild path, BOTH walls (r3 verdict item 7: README quoted a
-        # warm figure while the driver captured compile-included — ~4x
-        # apart and neither labeled): cold = engine build + jit compile
-        # + solve (what a user pays once per new shape), warm = the
-        # same rebuild with the program already in the jit cache (what
-        # every later same-shape rebuild pays)
         # role-INTRODUCING delta over the same live base (r4: the last
         # uniform-insert capability the reference has — T4/T5 axioms as
         # plain inserts, ``init/AxiomLoader.java:1051-1132``): a new
@@ -250,18 +244,34 @@ def main() -> None:
         )
         extra["incremental_role_delta_new_derivations"] = rres.derivations
 
-        inc2 = IncrementalClassifier()
-        inc2.add_text(snomed_shaped_ontology(n_classes=_INC_BASE_CLASSES))
-        inc2.drop_base_program()  # force the rebuild path
-        t0 = time.time()
-        inc2.add_text(delta)
-        extra["incremental_delta_rebuild_cold_s"] = round(time.time() - t0, 2)
-        inc3 = IncrementalClassifier()
-        inc3.add_text(snomed_shaped_ontology(n_classes=_INC_BASE_CLASSES))
-        inc3.drop_base_program()
-        t0 = time.time()
-        inc3.add_text(delta)
-        extra["incremental_delta_rebuild_warm_s"] = round(time.time() - t0, 2)
+        # rebuild path, BOTH walls (r3 verdict item 7: README quoted a
+        # warm figure while the driver captured compile-included — ~4x
+        # apart and neither labeled): cold = engine build + jit compile
+        # + solve (what a user pays once per new shape), warm = the
+        # same rebuild with the program served from the persistent
+        # compile cache (what every later identical-shape rebuild
+        # pays).  Three runs make both walls honest regardless of what
+        # an earlier bench left in ~/.cache: run 1 populates the cache
+        # for THIS corpus+delta (wall unrecorded — could be a stale
+        # hit), run 2 is a guaranteed cache hit (warm), run 3 forces a
+        # fresh compile by disabling the disk cache (cold)
+        def _rebuild_wall():
+            inc_r = IncrementalClassifier()
+            inc_r.add_text(
+                snomed_shaped_ontology(n_classes=_INC_BASE_CLASSES)
+            )
+            inc_r.drop_base_program()  # force the rebuild path
+            t0 = time.time()
+            inc_r.add_text(delta)
+            return round(time.time() - t0, 2)
+
+        _rebuild_wall()  # populate
+        extra["incremental_delta_rebuild_warm_s"] = _rebuild_wall()
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            extra["incremental_delta_rebuild_cold_s"] = _rebuild_wall()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", True)
 
         # ---- latency-sensitivity probe: GALEN-shaped 16k ----
         gtext = synthetic_ontology(

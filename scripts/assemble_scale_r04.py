@@ -16,31 +16,25 @@ if os.path.exists(p):
             seen.add(ln)
             lines.append(json.loads(ln))
 
-out = {
-    "what": (
-        "r4 SNOMED-scale story: the scanned uniform-chunk compile lever "
-        "(O(1) traced program in chunk count), the 300k memory row "
-        "re-measured under the tier-3+scan posture, the >=128k sharded "
-        "execution recorded with a durable per-superstep progress file, "
-        "a component-partitioned many-role 300k-class execution, and "
-        "the 96k window/tile slack experiments"
-    ),
-}
+out = {}
 
 for rec in lines:
     if rec.get("n_classes") == 300000 and rec.get("devices") == 8 and "step_compile_s" in rec:
+        # later lines overwrite earlier (the unroll=1 re-probe supersedes)
         out["sharded_probe_300k_tier3_scan"] = dict(
             rec,
             note=(
                 "measured under the r4 posture: mesh tier-3 (64 MB chunk "
-                "budget, serialized chunks) + scanned uniform chunks. "
-                "r3 measured 29.85 GB/shard temp under the stale tier-2 "
-                "posture; the v4-8 fit claim is now MEASUREMENT: live = "
-                "temp+args (args alias outputs under donation) = 9.67 "
-                "GB/shard virtual ~ 11 GB real at the ~1.15x calibration "
-                "- fits v4-8 (32 GB) and v5e-8 (16 GB). Compile wall "
-                "measured on ONE CPU core CONTENDED by the 128k "
-                "execution (load ~19): upper bound"
+                "budget, serialized chunks) + scanned uniform chunks + "
+                "mesh unroll=1. r3 measured 29.85 GB/shard temp under "
+                "the stale tier-2 posture; the v4-8 fit claim is now "
+                "MEASUREMENT: live = temp+args (args alias outputs "
+                "under donation) = "
+                f"{rec['per_shard_temp_gb'] + rec['per_shard_args_gb']:.2f} "
+                "GB/shard virtual, ~1.15x calibration to real - fits "
+                "v4-8 (32 GB) and v5e-8 (16 GB). Compile wall measured "
+                "on ONE CPU core CONTENDED by the concurrent 128k "
+                "execution: upper bound"
             ),
         )
     if rec.get("shape") == "galen" and rec.get("n_classes") == 128000 and rec.get("iterations"):
@@ -88,6 +82,27 @@ if w96:
             "15% utilization gap + non-MM sweeps (r3 mm_floor_analysis)"
         ),
     }
+
+pieces = {
+    "sharded_probe_300k_tier3_scan": (
+        "the 300k memory+compile row re-measured under the "
+        "tier-3+scan+unroll-1 posture"
+    ),
+    "executed_sharded_galen_128k": (
+        "the >=128k sharded execution recorded (durable per-superstep "
+        "progress for new launches)"
+    ),
+    "executed_300k_component_partitioned": (
+        "a component-partitioned many-role 300k-class execution with "
+        "oracle containment"
+    ),
+    "slack_experiments_96k": "the 96k window/tile slack experiments",
+}
+out["what"] = (
+    "r4 SNOMED-scale story (scanned uniform-chunk compile lever, O(1) "
+    "traced program in chunk count): "
+    + "; ".join(v for k, v in pieces.items() if k in out)
+)
 
 path = os.path.join(_REPO, "SCALE_r04.json")
 with open(path, "w") as f:
