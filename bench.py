@@ -65,11 +65,13 @@ from distel_tpu.core import oracle as cpu_oracle  # noqa: E402
 _V5E_INT8_OPS = 394e12
 _V5E_HBM_BPS = 819e9
 
-#: largest SNOMED-shaped size whose oracle saturation converges inside
-#: the 600 s budget on this host class (measured; the bench still
-#: falls back one tier if a slower host misses the budget)
-_CONVERGED_CLASSES = 8000
-_CONVERGED_FALLBACK = 3000
+#: largest SNOMED-shaped sizes whose oracle saturation converges inside
+#: the 600 s budget on this host class, largest first (r4 measured,
+#: CONTENDED upper bounds: 32k classes converge in 379 s, 24k in 194 s;
+#: 48k does NOT inside 653 s — so the chain starts at 32k, a slower
+#: host falls through one tier at a time, and the 3000-class
+#: last-resort tier guarantees SOME baseline ratio on any host)
+_CONVERGED_CHAIN = (32000, 24000, 8000, 3000)
 
 #: incremental base: above the delta fast path's 32k-concept
 #: eligibility floor (48k classes ≈ 66k concepts), so the bench times
@@ -180,16 +182,18 @@ def main() -> None:
     vs_converged = None
     if not custom:
         # ---- THE baseline ratio: largest size the oracle finishes ----
-        for conv_classes in (_CONVERGED_CLASSES, _CONVERGED_FALLBACK):
+        for conv_classes in _CONVERGED_CHAIN:
             ctext = snomed_shaped_ontology(n_classes=conv_classes)
             cnorm = normalize(parser.parse(ctext))
-            cidx = index_ontology(cnorm)
-            cengine = RowPackedSaturationEngine(cidx)
-            cres, _, c_warm = _saturate_timed(cengine)
+            # oracle FIRST: a non-converging tier then costs only its
+            # oracle budget, not a discarded engine compile+run too
             t0 = time.time()
             coracle = cpu_oracle.saturate(cnorm, time_budget_s=600.0)
             c_oracle_s = time.time() - t0
             if coracle.converged:
+                cidx = index_ontology(cnorm)
+                cengine = RowPackedSaturationEngine(cidx)
+                cres, _, c_warm = _saturate_timed(cengine)
                 vs_converged = round(
                     (cres.derivations / c_warm)
                     / (coracle.derived_count() / c_oracle_s),

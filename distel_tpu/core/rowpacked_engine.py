@@ -704,12 +704,14 @@ class RowPackedSaturationEngine:
                 tval_s[i, : len(o)] = True
             # group size bounds the deferred per-group output buffer
             # ([gch·rk, wlw] u32 — the memory cost of deferring the
-            # seg-OR); tier-3 postures halve it.  ``scan_group_bytes``
-            # is the test hook for forcing multi-group splits at small
-            # corpus sizes
-            group_bytes = scan_group_bytes or (
-                1 << (27 if self._serialize_chunks else 28)
-            )
+            # seg-OR).  256 MB measured best at the 300k/8-shard shape:
+            # vs 128 MB groups it cuts step compile 407 → 294 s with
+            # per-shard temp UNCHANGED at 4.4 GB (the serialized groups
+            # reuse the same peak); 512 MB only reaches 254 s while
+            # nudging temp up — the residual compile lives outside the
+            # group bodies.  ``scan_group_bytes`` is the test hook for
+            # forcing multi-group splits at small corpus sizes.
+            group_bytes = scan_group_bytes or (1 << 28)
             wlw = self.wc // self.n_shards
             gch = max(int(group_bytes // max(rk * wlw * 4, 1)), 1)
             groups = []

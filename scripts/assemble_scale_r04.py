@@ -20,21 +20,40 @@ out = {}
 
 for rec in lines:
     if rec.get("n_classes") == 300000 and rec.get("devices") == 8 and "step_compile_s" in rec:
-        # later lines overwrite earlier (the unroll=1 re-probe supersedes)
+        # later lines overwrite earlier (the posture re-probes
+        # supersede); every run's wall is kept in step_compile_runs_s
+        # and the published record's regime is labeled so a future
+        # appended probe cannot silently masquerade as a redeploy wall
+        runs = out.get("sharded_probe_300k_tier3_scan", {}).get(
+            "step_compile_runs_s", []
+        )
         out["sharded_probe_300k_tier3_scan"] = dict(
             rec,
             note=(
                 "measured under the r4 posture: mesh tier-3 (64 MB chunk "
-                "budget, serialized chunks) + scanned uniform chunks + "
-                "mesh unroll=1. r3 measured 29.85 GB/shard temp under "
-                "the stale tier-2 posture; the v4-8 fit claim is now "
-                "MEASUREMENT: live = temp+args (args alias outputs "
-                "under donation) = "
+                "budget, serialized chunks) + scanned uniform chunks "
+                "(256 MB write groups) + mesh unroll=1. r3 measured "
+                "29.85 GB/shard temp under the stale tier-2 posture; the "
+                "v4-8 fit claim is now MEASUREMENT: live = temp+args "
+                "(args alias outputs under donation) = "
                 f"{rec['per_shard_temp_gb'] + rec['per_shard_args_gb']:.2f} "
                 "GB/shard virtual, ~1.15x calibration to real - fits "
-                "v4-8 (32 GB) and v5e-8 (16 GB). Compile wall measured "
-                "on ONE CPU core CONTENDED by the concurrent 128k "
-                "execution: upper bound"
+                "v4-8 (32 GB) and v5e-8 (16 GB). step_compile_s here is "
+                "the REDEPLOY wall: the persistent compile cache serves "
+                "the identical program (the regime of the reference's "
+                "minutes-scale cluster relaunch, scripts/run-all.sh). "
+                "FRESH-shape compile walls, measured while the 128k "
+                "execution held ~60% of the single core (upper bounds): "
+                "407 s at 128 MB groups/10 bodies, 294 s at 256 MB/7, "
+                "254 s at 512 MB/5 - r2->r4: 4432 -> 925 -> 294 s "
+                "contended fresh, 67 s cached redeploy"
+            ),
+            step_compile_runs_s=runs + [rec["step_compile_s"]],
+            step_compile_regime=(
+                "cached-redeploy (persistent compile cache served the "
+                "identical program)"
+                if rec["step_compile_s"] < 150
+                else "fresh compile, contended single core"
             ),
         )
     if rec.get("shape") == "galen" and rec.get("n_classes") == 128000 and rec.get("iterations"):
