@@ -1,0 +1,22 @@
+"""Find the largest snomed-shaped size whose CPU-oracle saturation
+CONVERGES within bench.py's 600 s budget (verdict r3 item 10: grow the
+converged-denominator corpus).  Run QUIET — contention inflates oracle
+walls and would under-pick."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.core import oracle as cpu_oracle
+from distel_tpu.owl import parser
+
+for n in (48000, 32000, 24000, 16000):
+    norm = normalize(parser.parse(snomed_shaped_ontology(n_classes=n)))
+    t0 = time.time()
+    res = cpu_oracle.saturate(norm, time_budget_s=600.0)
+    wall = round(time.time() - t0, 1)
+    out = {"n_classes": n, "oracle_wall_s": wall,
+           "converged": bool(res.converged),
+           "facts": res.derivation_count()}
+    print(json.dumps(out), flush=True)
+    if res.converged:
+        break
