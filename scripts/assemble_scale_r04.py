@@ -70,6 +70,33 @@ for rec in lines:
     if rec.get("what", "").startswith("component-partitioned"):
         out["executed_300k_component_partitioned"] = rec
 
+if "executed_sharded_galen_128k" not in out:
+    out["executed_sharded_galen_128k_status"] = {
+        "status": (
+            "RUNNING at round end (relaunched FIRST THING this round, "
+            "22:34 Jul 31): 09:20:55 of single-core compute into the "
+            "8-shard virtual-mesh saturation of the 3-role 128k-class "
+            "corpus (161,638 concepts); launched with the r3 code "
+            "image, which records only at completion"
+        ),
+        "single_chip_target": {
+            "n_concepts": 161638, "iterations": 20,
+            "derivations": 5201685, "converged": True,
+        },
+        "cost_model_estimate": (
+            "~1.1e15 live MACs total at 30-60 Gops/s/core = 5-10 h; "
+            "the run crossed the top of that band while sharing the "
+            "core with this round's compile probes and test suite"
+        ),
+        "r5_instruction": (
+            "relaunch with scripts/scale_probe.py 128000 --shape galen "
+            "--devices 8 --execute --no-aot --oracle-budget 600 "
+            "--sample 2000 --out SCALE_r05_probes.jsonl — the probe "
+            "now writes a durable per-superstep progress file, so "
+            "partial execution is a recorded artifact this time"
+        ),
+    }
+
 out["galen_300k_mesh_exec_infeasibility"] = {
     "claim": (
         "the SINGLE-COMPONENT 300k-class mesh execution (any shape) "
