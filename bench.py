@@ -79,6 +79,93 @@ _CONVERGED_CHAIN = (32000, 24000, 8000, 3000)
 _INC_BASE_CLASSES = 48000
 
 
+#: transient-shaped backend failures worth retrying (the r4 capture died
+#: on a single ``UNAVAILABLE`` from the axon tunnel at engine
+#: construction — BENCH_r04.json is a traceback because nothing caught
+#: it; the reference's ``run-all.sh`` always writes its summary.txt)
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "connection",
+    "Connection",
+    "socket",
+    "tunnel",
+    "failed to initialize",
+    "Unable to initialize backend",
+)
+
+#: pointer emitted with a failure record so a voided round still tells
+#: the reader where the last full measurement lives
+_LAST_KNOWN_GOOD = (
+    "BENCH_r03.json (last parsed official record); "
+    "bench_r4_check.log (full r4 bench line, contention-biased)"
+)
+
+
+def _load1() -> float:
+    try:
+        with open("/proc/loadavg") as f:
+            return float(f.read().split()[0])
+    except Exception:
+        return -1.0
+
+
+def _is_transient(err: BaseException) -> bool:
+    s = f"{type(err).__name__}: {err}"
+    return any(m in s for m in _TRANSIENT_MARKERS)
+
+
+def _acquire_backend(attempts: int = 5, backoff_s: float = 60.0):
+    """Touch the accelerator with bounded retry before any real work.
+
+    Returns the jax module on success; raises the last error after
+    ``attempts`` tries.  A trivial jitted op round-trips the tunnel so
+    a half-up backend fails HERE, cheaply, instead of mid-bench.
+    """
+    last = None
+    for i in range(attempts):
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jax.devices()
+            int(jax.jit(lambda x: x + 1)(jnp.zeros(4))[0])
+            return jax
+        except Exception as e:  # noqa: BLE001 — classified below
+            last = e
+            if not _is_transient(e):
+                raise
+            if i < attempts - 1:
+                print(
+                    f"# backend attempt {i + 1}/{attempts} failed "
+                    f"({type(e).__name__}); retrying in {backoff_s:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(backoff_s)
+    raise last
+
+
+def _emit_failure(stage: str, err: BaseException, attempts: int) -> None:
+    """One parseable JSON line instead of a traceback (r4 weak #1)."""
+    print(
+        json.dumps(
+            {
+                "metric": "axiom_derivations_per_sec",
+                "value": 0.0,
+                "unit": "derivations/s",
+                "vs_baseline": 0.0,
+                "platform": "tpu_unavailable",
+                "failed_stage": stage,
+                "error": f"{type(err).__name__}: {err}"[:400],
+                "attempts": attempts,
+                "load1": _load1(),
+                "last_known_good": _LAST_KNOWN_GOOD,
+            }
+        )
+    )
+
+
 def _timed(f) -> float:
     t0 = time.time()
     f()
@@ -97,6 +184,40 @@ def _saturate_timed(engine):
 
 
 def main() -> None:
+    """Capture-proof wrapper: whatever the backend weather, exactly one
+    JSON line reaches stdout (r4 verdict task 2)."""
+    load1_start = _load1()
+    try:
+        _acquire_backend()
+    except Exception as e:  # noqa: BLE001
+        # non-transient errors raise on the first probe, before any retry
+        _emit_failure("backend_init", e, 5 if _is_transient(e) else 1)
+        return
+    last: BaseException = RuntimeError("unreachable")
+    for attempt in range(2):
+        try:
+            _run_bench(load1_start)
+            return
+        except Exception as e:  # noqa: BLE001
+            last = e
+            if not _is_transient(e):
+                _emit_failure("bench_body", e, attempt + 1)
+                return
+            if attempt == 0:  # no backoff after the final attempt
+                print(
+                    f"# transient bench failure ({type(e).__name__}); "
+                    "re-probing backend and retrying once",
+                    file=sys.stderr,
+                )
+                time.sleep(60.0)
+                try:
+                    _acquire_backend(attempts=3)
+                except Exception:  # noqa: BLE001 — recorded by final emit
+                    pass
+    _emit_failure("bench_body", last, 2)
+
+
+def _run_bench(load1_start: float) -> None:
     import jax
 
     from distel_tpu.config import enable_compile_cache
@@ -321,6 +442,13 @@ def main() -> None:
                 "baseline_cpu_dps": round(oracle_dps, 1),
                 "baseline_budget_s": 90.0,
                 "baseline_converged": oracle_result.converged,
+                # contention disclosure (r4 weak #2: a background job
+                # holding the single core slows the CPU oracle ~2x and
+                # inflates vs_baseline; load1 at bench start makes the
+                # bias visible in the record itself)
+                "load1_start": round(load1_start, 2),
+                "load1_end": round(_load1(), 2),
+                "contended": load1_start > 1.25,
                 "step_profile": step_profile,
                 **roofline,
                 **extra,

@@ -187,12 +187,30 @@ def check_embed_fits(allow_shrink: bool, **dims: Tuple[int, int]) -> None:
 
 
 def observed_loop(
-    observe_step, s, r, init_total: int, unroll: int, budget: int, observer
+    observe_step, s, r, init_total: int, unroll: int, budget: int, observer,
+    state_observer=None,
 ):
     """Shared superstep/observer protocol of both engines'
     ``saturate_observed``: run ``observe_step`` (returning
     ``(s, r, changed, live_bits)``) until convergence or budget, calling
-    ``observer(iteration, derivations, changed)`` after each round."""
+    ``observer(iteration, derivations, changed)`` after each round.
+
+    ``state_observer(iteration, derivations, changed, s, r)`` — if given —
+    additionally receives the LIVE device state after each round, so a
+    long run can snapshot mid-flight (the r4 verdict's resume ask: two
+    consecutive rounds lost a multi-hour 128k execution at teardown
+    because in-flight state was never persisted).  The callback runs
+    synchronously between rounds; the arrays it sees are the round's
+    outputs and are not donated until the next ``observe_step`` call, so
+    fetching them inside the callback is race-free.
+
+    The state arrives in the CALLING ENGINE's working layout — wire-packed
+    subsumer-major uint32 (sp, rp) from ``RowPackedSaturationEngine``, but
+    UNPACKED x-major bool (s, r) from the dense ``SaturationEngine`` — so
+    a snapshot callback is engine-specific: only the row-packed pair may
+    be saved as a ``transposed=True`` wire snapshot
+    (``runtime/checkpoint.py`` v2); wrapping dense bool arrays that way
+    would persist garbage words without an error."""
     iteration, converged, total = 0, False, init_total
     while iteration < budget:
         s, r, changed_dev, bits = observe_step(s, r)
@@ -201,6 +219,8 @@ def observed_loop(
         total = _host_bit_total(bits_host)
         if observer is not None:
             observer(iteration, total - init_total, bool(changed))
+        if state_observer is not None:
+            state_observer(iteration, total - init_total, bool(changed), s, r)
         if not changed:
             converged = True
             break
@@ -547,6 +567,7 @@ class SaturationEngine:
         max_iters: int = 10_000,
         *,
         observer=None,
+        state_observer=None,
         initial: Optional[Tuple[jax.Array, jax.Array]] = None,
         allow_incomplete: bool = False,
     ) -> SaturationResult:
@@ -578,7 +599,8 @@ class SaturationEngine:
         init_total = _host_bit_total(fetch_global(self._live_bits(s, r)))
         budget = _pad_up(max_iters, self.unroll)
         s, r, iteration, total, converged = observed_loop(
-            self._observe_jit, s, r, init_total, self.unroll, budget, observer
+            self._observe_jit, s, r, init_total, self.unroll, budget, observer,
+            state_observer=state_observer,
         )
         packed_s, packed_r = self._pack_jit(s), self._pack_jit(r)
         return self._finish(

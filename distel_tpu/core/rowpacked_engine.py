@@ -90,6 +90,57 @@ from distel_tpu.ops.bitpack import (
 _SCAN_CHUNK_THRESHOLD = 24
 
 
+def _factored_closure_tables(h, nf4_roles, chain_roles):
+    """``(h2, m4, m6)``: the factored-mask encoding — ``h`` extended
+    with one all-zero SENTINEL role row (padded links carry the
+    sentinel id, so their mask column is dead), then gathered per table
+    row: ``m4[j, ρ] = H[ρ, s_j]`` / ``m6[p, ρ] = H[ρ, r_p]``.  The ONE
+    place this encoding lives: ``__init__`` builds the compile-time
+    masks through it and :meth:`RowPackedSaturationEngine.
+    rebind_role_closure` rebuilds them under a grown closure — a drift
+    between the two would bind wrong masks onto a compiled program.
+    ``nf4_roles`` / ``chain_roles`` are the per-row role columns, or
+    None when the rule is off (empty table)."""
+    n_roles = h.shape[0]
+    h2 = np.zeros((n_roles + 1, n_roles), np.int8)
+    h2[:n_roles] = h
+
+    def tab(roles):
+        if roles is None:
+            return np.zeros((0, n_roles + 1), np.int8)
+        return np.ascontiguousarray(h2[:, roles].T)
+
+    return h2, tab(nf4_roles), tab(chain_roles)
+
+
+def _fill_window_slabs(offs_l, c01_l, nch, T):
+    """[nch, T]-padded window tables ``(offs, c01, tval)`` — ``tval``
+    False marks pad slots, which the scan body's live multiplier zeroes
+    (and the Pallas per-tile skip then drops).  The ONE slab layout,
+    shared by ``build_scan`` and :meth:`RowPackedSaturationEngine.
+    rebind_role_closure` so it cannot drift between compile time and a
+    later mask rebind."""
+    offs_s = np.zeros((nch, T), np.int32)
+    c01_s = np.zeros((nch, T, 2), np.int32)
+    tval_s = np.zeros((nch, T), bool)
+    for i, (o, c) in enumerate(zip(offs_l, c01_l)):
+        offs_s[i, : len(o)] = o
+        c01_s[i, : len(o)] = c
+        tval_s[i, : len(o)] = True
+    return offs_s, c01_s, tval_s
+
+
+def _stack_span_masks(mask_tab, spans, rk):
+    """[nch, rk, n_roles+1] per-chunk factored-mask slab: each kept
+    span's rows tail-padded to ``rk`` with all-zero mask rows (pad rows
+    contribute nothing).  Shared by ``build_scan`` and
+    ``rebind_role_closure`` — see :func:`_fill_window_slabs`."""
+    return np.stack([
+        np.pad(mask_tab[a0:a1], ((0, rk - (a1 - a0)), (0, 0)))
+        for a0, a1 in spans
+    ])
+
+
 def _pos_maps(writers, n_rows):
     """Layered row → concat-position maps; position ``sentinel`` indexes
     a trailing always-False slot.  Rows written by k writers occupy k
@@ -154,6 +205,7 @@ class RowPackedSaturationEngine:
         link_window: Optional[Tuple[int, int]] = None,
         scan_chunks: Optional[bool] = None,
         scan_group_bytes: Optional[int] = None,
+        window_headroom: int = 0,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -183,12 +235,22 @@ class RowPackedSaturationEngine:
         engaged once the budget-driven chunk count exceeds
         ``_SCAN_CHUNK_THRESHOLD``, the regime where XLA pass scaling
         over per-chunk bodies dominates compile time: measured r3 at
-        300k classes, 925 s step compile from ~10^3 chunk bodies)."""
+        300k classes, 925 s step compile from ~10^3 chunk bodies).
+        ``window_headroom``: extra live-window slots reserved per CR4/CR6
+        chunk so a LATER role-closure growth (an ``r ⊑ s`` delta between
+        existing roles) can be re-bound onto this engine's compiled
+        program via :meth:`rebind_role_closure` instead of a full
+        rebuild.  Reserved slots are inert until used: scan-mode slots
+        carry ``tval=False`` (the live multiplier zeroes the operand and
+        the Pallas per-tile skip drops the MXU work); unrolled-mode
+        slots point at the padded link-table tail, whose sentinel link
+        roles hit the factored mask's all-zero column."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
                 raise ValueError(f"unknown rules: {sorted(unknown)}")
         self._rules = rules
+        self._window_headroom = int(window_headroom)
         self.idx = idx
         self.mesh = mesh
         self.word_axis = word_axis
@@ -576,21 +638,18 @@ class RowPackedSaturationEngine:
         # They stay *arguments* to the jitted run (embedded constants
         # get serialized into every remote compile request).
         n_roles = h.shape[0]
-        h2 = np.zeros((n_roles + 1, n_roles), np.int8)
-        h2[:n_roles] = h
         self._link_roles = np.full(self.nl, n_roles, np.int32)  # sentinel
         if idx.n_links:
             self._link_roles[: idx.n_links] = link_roles
 
-        m4 = np.zeros((0, n_roles + 1), np.int8)
-        if self._has4:
-            # m4[j, ρ] = H[ρ, s_j] — the link's role must be a
-            # (transitive) subrole of the axiom's s
-            m4 = np.ascontiguousarray(h2[:, idx.nf4[:, 0]].T)
-        m6 = np.zeros((0, n_roles + 1), np.int8)
-        if self._has6:
-            # m6[p, ρ] = H[ρ, r_p] — first-leg subrole closure
-            m6 = np.ascontiguousarray(h2[:, idx.chain_pairs[:, 0]].T)
+        # m4[j, ρ] = H[ρ, s_j] (link role must be a transitive subrole
+        # of the axiom's s); m6[p, ρ] = H[ρ, r_p] (first-leg closure) —
+        # shared encoding with rebind_role_closure
+        _h2, m4, m6 = _factored_closure_tables(
+            h,
+            idx.nf4[:, 0] if self._has4 else None,
+            idx.chain_pairs[:, 0] if self._has6 else None,
+        )
 
         # ---- static live-tile schedule: each CR4/CR6 row chunk
         # contracts ONLY the L-windows containing links whose role is a
@@ -607,7 +666,7 @@ class RowPackedSaturationEngine:
         # are 0, so they contribute nothing (and windows clamped at the
         # link-table tail re-derive earlier links — OR is idempotent).
         # Chunks with NO relevant links are dropped outright.
-        def live_windows(role_list, lcn):
+        def live_windows(role_list, lcn, h_arg=None):
             """Static live L-window offsets (offs, c01) for a row span
             whose axiom roles are ``role_list`` — shared by the per-chunk
             and the scanned-slab builders; None when no link can satisfy
@@ -617,9 +676,12 @@ class RowPackedSaturationEngine:
             filler/link-role window contents are dynamic slices of the
             SHARED [nl] tables at runtime — stacking copies here would
             replicate them up to n_chunks times in the jitted-run
-            arguments."""
+            arguments.  ``h_arg`` overrides the build-time role closure —
+            :meth:`rebind_role_closure` recomputes the schedule under a
+            GROWN closure against the same link table."""
             croles = np.unique(role_list)
-            rel = np.flatnonzero(h[:, croles].any(axis=1))
+            hh = h if h_arg is None else h_arg
+            rel = np.flatnonzero(hh[:, croles].any(axis=1))
             live = np.flatnonzero(np.isin(self._link_roles, rel))
             if link_window is not None:
                 w0, w1 = link_window
@@ -644,15 +706,42 @@ class RowPackedSaturationEngine:
             ).astype(np.int32)
             return offs, c01
 
+        def _pad_window(lcn):
+            """(offset, c01) of an inert reserve window: parked at the
+            link-table tail, where padded rows carry the sentinel link
+            role — the factored mask's all-zero column — so the window's
+            operand is zero and the Pallas per-tile skip drops it.  (A
+            tail window may also cover real trailing links; re-deriving
+            them is idempotent under OR.)"""
+            off = max(self.nl - lcn, 0)
+            return off, (
+                off // self.lc,
+                min((off + lcn - 1) // self.lc, self.n_lchunks - 1),
+            )
+
         def build_tiles(chunks, role_of, lcn):
-            kept, tiles = [], []
+            kept, tiles, dropped_roles = [], [], []
+            hw = self._window_headroom
+            p_off, p_c01 = _pad_window(lcn)
             for raw, inv, piece in chunks:
                 win = live_windows(role_of(raw), lcn)
                 if win is None:
+                    # record the dead chunk's roles: rebind must refuse
+                    # if a grown closure would make it live (its rows
+                    # are absent from the compiled program)
+                    dropped_roles.append(np.unique(role_of(raw)))
                     continue
+                offs, c01 = win
+                if hw:
+                    offs = np.concatenate(
+                        [offs, np.full(hw, p_off, np.int32)]
+                    )
+                    c01 = np.concatenate(
+                        [c01, np.tile(np.asarray(p_c01, np.int32), (hw, 1))]
+                    )
                 kept.append((raw, inv, piece))
-                tiles.append((jnp.asarray(win[0]), jnp.asarray(win[1])))
-            return kept, tiles
+                tiles.append((jnp.asarray(offs), jnp.asarray(c01)))
+            return kept, tiles, dropped_roles
 
         def build_scan(rk, lcn, tab_roles, rows_src, tab_targets,
                        mask_tab, fd_idx, fd_pad, want_readers=True):
@@ -673,18 +762,20 @@ class RowPackedSaturationEngine:
             folded to a per-chunk dirty scalar by one vectorized gather."""
             K = len(tab_roles)
             spans = [(o, min(o + rk, K)) for o in range(0, K, rk)]
-            rows_l, fdx_l, m_l = [], [], []
+            rows_l, fdx_l = [], []
             offs_l, c01_l, tgt_l, reader_rows = [], [], [], []
+            spans_kept, spans_dropped = [], []
             for a0, a1 in spans:
                 win = live_windows(tab_roles[a0:a1], lcn)
                 if win is None:
+                    spans_dropped.append((a0, a1))
                     continue
+                spans_kept.append((a0, a1))
                 pad = rk - (a1 - a0)
                 rows_l.append(np.pad(rows_src[a0:a1], (0, pad)))
                 fdx_l.append(
                     np.pad(fd_idx[a0:a1], (0, pad), constant_values=fd_pad)
                 )
-                m_l.append(np.pad(mask_tab[a0:a1], ((0, pad), (0, 0))))
                 offs_l.append(win[0])
                 c01_l.append(win[1])
                 tgt_l.append(np.pad(tab_targets[a0:a1], (0, pad)))
@@ -694,14 +785,12 @@ class RowPackedSaturationEngine:
                 return None
             nch = len(rows_l)
             n_windows = np.asarray([len(o) for o in offs_l])
-            T = int(n_windows.max())
-            offs_s = np.zeros((nch, T), np.int32)
-            c01_s = np.zeros((nch, T, 2), np.int32)
-            tval_s = np.zeros((nch, T), bool)
-            for i, (o, c) in enumerate(zip(offs_l, c01_l)):
-                offs_s[i, : len(o)] = o
-                c01_s[i, : len(o)] = c
-                tval_s[i, : len(o)] = True
+            # reserve slots stay tval=False until rebind_role_closure
+            # fills them for a grown closure
+            T = int(n_windows.max()) + self._window_headroom
+            offs_s, c01_s, tval_s = _fill_window_slabs(
+                offs_l, c01_l, nch, T
+            )
             # group size bounds the deferred per-group output buffer
             # ([gch·rk, wlw] u32 — the memory cost of deferring the
             # seg-OR).  256 MB measured best at the 300k/8-shard shape:
@@ -735,7 +824,7 @@ class RowPackedSaturationEngine:
                 for x in (
                     np.stack(rows_l).astype(np.int32),
                     np.stack(fdx_l).astype(np.int32),
-                    np.stack(m_l),
+                    _stack_span_masks(mask_tab, spans_kept, rk),
                     offs_s,
                     c01_s,
                     tval_s,
@@ -749,6 +838,12 @@ class RowPackedSaturationEngine:
                 "groups": groups,
                 "slabs": slabs,
                 "n_windows": n_windows,
+                # rebind_role_closure's structural record: which row
+                # spans the compiled program carries (and which it
+                # dropped as dead — a grown closure reviving one forces
+                # the rebuild path)
+                "spans_kept": spans_kept,
+                "spans_dropped": spans_dropped,
             }
 
         # the whole plan-table pytree (closure masks + live-tile
@@ -776,6 +871,7 @@ class RowPackedSaturationEngine:
                 else None
             )
             self._cr4_tiles, self._cr6_tiles = [], []
+            self._cr4_dropped_roles = self._cr6_dropped_roles = []
             self._masks = (
                 jnp.asarray(self._fillers.astype(np.int32)),
                 jnp.asarray(self._link_roles),
@@ -784,12 +880,16 @@ class RowPackedSaturationEngine:
             )
         else:
             self._scan4 = self._scan6 = None
-            self._cr4_chunks, self._cr4_tiles = build_tiles(
-                self._cr4_chunks, lambda raw: idx.nf4[raw, 0], self.lc4
+            self._cr4_chunks, self._cr4_tiles, self._cr4_dropped_roles = (
+                build_tiles(
+                    self._cr4_chunks, lambda raw: idx.nf4[raw, 0], self.lc4
+                )
             )
-            self._cr6_chunks, self._cr6_tiles = build_tiles(
-                self._cr6_chunks, lambda raw: idx.chain_pairs[raw, 0],
-                self.lc,
+            self._cr6_chunks, self._cr6_tiles, self._cr6_dropped_roles = (
+                build_tiles(
+                    self._cr6_chunks, lambda raw: idx.chain_pairs[raw, 0],
+                    self.lc,
+                )
             )
             self._masks = (
                 jnp.asarray(m4),
@@ -799,6 +899,11 @@ class RowPackedSaturationEngine:
                 tuple(self._cr4_tiles),
                 tuple(self._cr6_tiles),
             )
+
+        # rebind_role_closure re-derives window schedules under a grown
+        # closure through the same builders the compile-time plan used
+        self._live_windows = live_windows
+        self._make_pad_window = _pad_window
 
         # one packed-output matmul plan per row-chunk, shared by every
         # (equal-sized) L-window.  dtype: forwarded only when the caller
@@ -1202,6 +1307,184 @@ class RowPackedSaturationEngine:
             jnp.ones(max(self.n_lchunks, 1), bool),
             jnp.ones(self.nc, bool),
         )
+
+    def rebind_role_closure(self, new_closure) -> bool:
+        """Re-bind this engine's COMPILED program to a grown role
+        closure without recompiling — the masks-only partial rebuild for
+        deltas that add ``r ⊑ s`` between existing roles (the last
+        delta shape that previously forced a full rebuild; reference
+        parity: role-hierarchy axioms are uniform inserts over live
+        stores, ``init/AxiomLoader.java:1051-1132``, with downstream
+        re-emission ``RolePairHandler.java:380-444``).
+
+        Sound because the closure reaches the compiled program only
+        through runtime ARGUMENTS with static shapes: the factored
+        CR4/CR6 masks (``m4``/``m6`` or the scanned ``m`` slabs) and the
+        live-window offset/validity tables.  This method recomputes all
+        of them under ``new_closure`` through the same builders the
+        compile-time plan used and swaps them into ``self._masks``; the
+        traced program (row chunks, seg-OR write plans, gate readers)
+        is untouched.  The caller re-enters the fixed point from the
+        old embedded state — monotonicity makes that a sound warm start
+        under a grown closure.
+
+        Returns False — with the engine UNTOUCHED — when the new
+        closure needs structure the program lacks: a row chunk that was
+        dead at build time (no live links) coming alive, or a chunk
+        needing more live windows than its static slots (including the
+        ``window_headroom`` reserve).  Requires same role count and a
+        SUPERSET closure (EL+ deltas only grow it; shrinking is belief
+        revision, out of scope — and a shrunk mask under stale S/R bits
+        would be unsound anyway).
+        """
+        idx = self.idx
+        h_old = np.asarray(idx.role_closure)
+        h_new = np.asarray(new_closure, dtype=h_old.dtype)
+        if h_new.shape != h_old.shape:
+            return False
+        ob, nb = h_old.astype(bool), h_new.astype(bool)
+        if np.any(ob & ~nb):
+            return False  # not a superset: refuse
+        if np.array_equal(ob, nb):
+            return True  # nothing to do
+
+        _h2, m4_new, m6_new = _factored_closure_tables(
+            h_new,
+            idx.nf4[:, 0] if self._has4 else None,
+            idx.chain_pairs[:, 0] if self._has6 else None,
+        )
+
+        def windows_fit(role_list, lcn, slots):
+            """New live windows for a span, or None when they exceed
+            ``slots`` (the program's static capacity)."""
+            win = self._live_windows(role_list, lcn, h_arg=h_new)
+            if win is None:
+                # superset closure: a live span cannot go dead; an
+                # all-dead span is vacuously fit (no live links)
+                return np.zeros(0, np.int32), np.zeros((0, 2), np.int32)
+            offs, c01 = win
+            if len(offs) > slots:
+                return None
+            return offs, c01
+
+        if self._scan_mode:
+            new_slabs = {}
+            for key, d, tab_roles, mask_tab in (
+                ("s4", self._scan4,
+                 idx.nf4[:, 0] if self._has4 else None, m4_new),
+                ("s6", self._scan6,
+                 idx.chain_pairs[:, 0] if self._has6 else None, m6_new),
+            ):
+                if d is None:
+                    # the rule had NO live chunk at build (or no rows):
+                    # a grown closure reviving any span needs a program
+                    # this engine never compiled
+                    if tab_roles is not None and len(tab_roles):
+                        rk = self._scan_rk[0 if key == "s4" else 1]
+                        lcn = self.lc4 if key == "s4" else self.lc
+                        K = len(tab_roles)
+                        for a0 in range(0, K, rk):
+                            a1 = min(a0 + rk, K)
+                            if self._live_windows(
+                                tab_roles[a0:a1], lcn, h_arg=h_new
+                            ) is not None:
+                                return False
+                    continue
+                for a0, a1 in d["spans_dropped"]:
+                    if self._live_windows(
+                        tab_roles[a0:a1], d["lcn"], h_arg=h_new
+                    ) is not None:
+                        return False  # dead chunk came alive
+                nch, T, rk = d["nch"], d["T"], d["rk"]
+                offs_l, c01_l = [], []
+                for a0, a1 in d["spans_kept"]:
+                    fit = windows_fit(tab_roles[a0:a1], d["lcn"], T)
+                    if fit is None:
+                        return False
+                    offs_l.append(fit[0])
+                    c01_l.append(fit[1])
+                # same slab layout + mask padding as build_scan, via the
+                # shared helpers
+                offs_s, c01_s, tval_s = _fill_window_slabs(
+                    offs_l, c01_l, nch, T
+                )
+                old = d["slabs"]
+                new_slabs[key] = (
+                    old[0], old[1],
+                    jnp.asarray(
+                        _stack_span_masks(mask_tab, d["spans_kept"], rk)
+                    ),
+                    jnp.asarray(offs_s),
+                    jnp.asarray(c01_s),
+                    jnp.asarray(tval_s),
+                )
+                new_slabs[key + "_nw"] = np.asarray(
+                    [len(o) for o in offs_l]
+                )
+            # ---- all checks passed: swap atomically
+            if self._scan4 is not None:
+                self._scan4["slabs"] = new_slabs["s4"]
+                self._scan4["n_windows"] = new_slabs["s4_nw"]
+            if self._scan6 is not None:
+                self._scan6["slabs"] = new_slabs["s6"]
+                self._scan6["n_windows"] = new_slabs["s6_nw"]
+            self._masks = (
+                self._masks[0],
+                self._masks[1],
+                self._scan4["slabs"] if self._scan4 else (),
+                self._scan6["slabs"] if self._scan6 else (),
+            )
+        else:
+            new_tiles = {}
+            for key, chunks, tiles, dropped, role_of, lcn in (
+                ("t4", self._cr4_chunks, self._cr4_tiles,
+                 self._cr4_dropped_roles,
+                 lambda raw: idx.nf4[raw, 0], self.lc4),
+                ("t6", self._cr6_chunks, self._cr6_tiles,
+                 self._cr6_dropped_roles,
+                 lambda raw: idx.chain_pairs[raw, 0], self.lc),
+            ):
+                for roles in dropped:
+                    if self._live_windows(roles, lcn, h_arg=h_new) \
+                            is not None:
+                        return False  # dead chunk came alive
+                p_off, p_c01 = self._make_pad_window(lcn)
+                rebuilt = []
+                for (raw, _inv, _piece), (offs_old, _c01_old) in zip(
+                    chunks, tiles
+                ):
+                    slots = int(offs_old.shape[0])
+                    fit = windows_fit(role_of(raw), lcn, slots)
+                    if fit is None:
+                        return False
+                    offs, c01 = fit
+                    pad = slots - len(offs)
+                    if pad:
+                        # inert reserve windows at the padded tail (the
+                        # tile loop's window count is static)
+                        offs = np.concatenate(
+                            [offs, np.full(pad, p_off, np.int32)]
+                        )
+                        c01 = np.concatenate([
+                            c01,
+                            np.tile(np.asarray(p_c01, np.int32), (pad, 1)),
+                        ])
+                    rebuilt.append((jnp.asarray(offs), jnp.asarray(c01)))
+                new_tiles[key] = rebuilt
+            self._cr4_tiles = new_tiles["t4"]
+            self._cr6_tiles = new_tiles["t6"]
+            self._masks = (
+                jnp.asarray(m4_new),
+                jnp.asarray(m6_new),
+                self._masks[2],
+                self._masks[3],
+                tuple(self._cr4_tiles),
+                tuple(self._cr6_tiles),
+            )
+        import dataclasses
+
+        self.idx = dataclasses.replace(idx, role_closure=h_new)
+        return True
 
     def step_cost_model(self) -> dict:
         """Analytic per-superstep cost from the static plan shapes, for
@@ -1830,6 +2113,7 @@ class RowPackedSaturationEngine:
         max_iters: int = 10_000,
         *,
         observer=None,
+        state_observer=None,
         initial: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         allow_incomplete: bool = False,
     ) -> SaturationResult:
@@ -1900,6 +2184,7 @@ class RowPackedSaturationEngine:
         sp, rp, iteration, total, converged = observed_loop(
             observe_step,
             sp, rp, init_total, self.unroll, budget, observer,
+            state_observer=state_observer,
         )
         if not converged and not allow_incomplete:
             raise RuntimeError(

@@ -72,8 +72,36 @@ def _parse_args(argv=None):
                          "set) — the r3 128k run died at round end with "
                          "NO record of 5+ hours of execution; this file "
                          "makes partial progress a recorded artifact")
+    ap.add_argument("--snapshot-every", type=int, default=None,
+                    help="with --execute: atomically persist the packed "
+                         "S/R state every K observed superstep rounds "
+                         "(plus once at convergence), so a killed "
+                         "multi-hour run resumes instead of restarting "
+                         "— rounds 3 AND 4 both lost the 128k execution "
+                         "at teardown for want of this.  0 disables; "
+                         "default 5 when a snapshot path is resolvable "
+                         "(--snapshot or --out).  Snapshots are "
+                         "uncompressed .npz (zlib on a multi-GB state "
+                         "costs minutes of the one core the supersteps "
+                         "need)")
+    ap.add_argument("--snapshot", default=None,
+                    help="snapshot path (default: <out>.snapshot.npz)")
+    ap.add_argument("--resume-from", default=None,
+                    help="resume a killed --execute run from its "
+                         "snapshot: the state re-embeds BY NAME onto "
+                         "this run's index (stable ids make that exact "
+                         "for the same corpus args), saturation "
+                         "continues from the persisted closure — sound "
+                         "because EL+ saturation is monotone — and the "
+                         "record reports resumed + total derivation "
+                         "accounting")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
-    return ap.parse_args(argv)
+    args = ap.parse_args(argv)
+    if args.resume_from and not args.execute:
+        # launch-time guard: all resume handling lives on the execute
+        # path, and a silently ignored --resume-from costs hours
+        ap.error("--resume-from requires --execute")
+    return args
 
 
 def main() -> None:
@@ -184,34 +212,133 @@ def run_probe(args) -> None:
         progress = args.progress_file or (
             args.out + ".progress" if args.out else None
         )
+        snap_path = args.snapshot or (
+            args.out + ".snapshot.npz" if args.out else None
+        )
+        if args.snapshot_every and snap_path is None:
+            # fail at LAUNCH, not hours in: an explicit --snapshot-every
+            # with no resolvable path would otherwise be a silent no-op
+            raise SystemExit(
+                "--snapshot-every needs a snapshot path: pass --snapshot "
+                "or --out"
+            )
+        snap_every = (
+            args.snapshot_every
+            if args.snapshot_every is not None
+            else (5 if snap_path else 0)
+        )
+        snap_state = None
+        base_derivs = base_iters = 0
+        if args.resume_from:
+            from distel_tpu.runtime.checkpoint import load_snapshot_state
+
+            t0 = time.time()
+            snap_state, sinfo = load_snapshot_state(args.resume_from, idx=idx)
+            base_derivs = sinfo["derivations"]
+            base_iters = sinfo["iterations"]
+            rec["resumed_from"] = {
+                "path": args.resume_from,
+                "iterations": base_iters,
+                "derivations": base_derivs,
+                "load_s": round(time.time() - t0, 1),
+            }
+        want_snap = bool(snap_path) and snap_every > 0
         t0 = time.time()
-        if progress:
+        if progress or want_snap:
             # observed fixed point: one host sync per superstep round
             # (noise next to the multi-hour virtual-mesh step walls)
-            # buys a durable per-iteration record.  NOTE the observed
-            # program is jitted separately from the AOT-measured
-            # while-loop program above, so the FIRST round's wall below
-            # includes its compile — rec labels both so exec_wall_s is
-            # not mistaken for a pure-execution figure
-            with open(progress, "a") as f:
-                f.write(json.dumps({
-                    "run_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                    **rec,
-                }) + "\n")
+            # buys a durable per-iteration record and/or resumable
+            # snapshots — an explicit --snapshot must work even with no
+            # progress file configured.  NOTE the observed program is
+            # jitted separately from the AOT-measured while-loop program
+            # above, so the FIRST round's wall below includes its
+            # compile — rec labels both so exec_wall_s is not mistaken
+            # for a pure-execution figure
             first_round = []
-
-            def observer(iteration, derivations, changed):
-                if not first_round:
-                    first_round.append(round(time.time() - t0, 1))
+            observer = None
+            if progress:
                 with open(progress, "a") as f:
                     f.write(json.dumps({
-                        "iteration": int(iteration),
-                        "derivations": int(derivations),
-                        "changed": bool(changed),
-                        "wall_s": round(time.time() - t0, 1),
+                        "run_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                        **rec,
                     }) + "\n")
 
-            result = engine.saturate_observed(observer=observer)
+                def observer(iteration, derivations, changed):
+                    if not first_round:
+                        first_round.append(round(time.time() - t0, 1))
+                    with open(progress, "a") as f:
+                        f.write(json.dumps({
+                            "iteration": int(iteration),
+                            "derivations": int(derivations),
+                            "changed": bool(changed),
+                            "wall_s": round(time.time() - t0, 1),
+                        }) + "\n")
+
+            state_observer = None
+            if want_snap:
+                from distel_tpu.core.engine import SaturationResult
+                from distel_tpu.runtime.checkpoint import save_snapshot
+
+                snap_tmp = snap_path + ".tmp.npz"
+                rounds_seen = [0]
+
+                def state_observer(iteration, derivations, changed, sp, rp):
+                    # every K rounds, plus unconditionally at convergence
+                    # (the converged closure is the artifact the next
+                    # round's containment / taxonomy work wants)
+                    rounds_seen[0] += 1
+                    if changed and rounds_seen[0] % snap_every:
+                        return
+                    ts = time.time()
+                    try:
+                        _write_snapshot(
+                            iteration, derivations, changed, ts, sp, rp
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        # a failed snapshot must NEVER kill the
+                        # multi-hour run it exists to protect (ENOSPC,
+                        # fs hiccup on a multi-GB write) — log and run on
+                        if progress:
+                            with open(progress, "a") as f:
+                                f.write(json.dumps({
+                                    "snapshot_error":
+                                        f"{type(e).__name__}: {e}"[:300],
+                                    "iteration": int(iteration),
+                                }) + "\n")
+
+                def _write_snapshot(
+                    iteration, derivations, changed, ts, sp, rp
+                ):
+                    # CUMULATIVE accounting in the snapshot (iterations
+                    # AND derivations), so chains of resumes stay
+                    # self-consistent
+                    save_snapshot(
+                        snap_tmp,
+                        SaturationResult(
+                            packed_s=sp, packed_r=rp,
+                            iterations=base_iters + int(iteration),
+                            derivations=base_derivs + int(derivations),
+                            idx=idx, converged=not changed, transposed=True,
+                        ),
+                        compressed=False,
+                    )
+                    os.replace(snap_tmp, snap_path)
+                    if progress:
+                        with open(progress, "a") as f:
+                            f.write(json.dumps({
+                                "snapshot": snap_path,
+                                "iteration_total":
+                                    base_iters + int(iteration),
+                                "derivations_total":
+                                    base_derivs + int(derivations),
+                                "snapshot_s": round(time.time() - ts, 1),
+                            }) + "\n")
+
+            result = engine.saturate_observed(
+                observer=observer,
+                state_observer=state_observer,
+                initial=snap_state,
+            )
             rec["observed_mode"] = True
             if first_round:
                 # ≈ observed-program compile + one superstep round; the
@@ -219,10 +346,15 @@ def run_probe(args) -> None:
                 # while-loop program
                 rec["first_round_wall_s"] = first_round[0]
         else:
-            result = engine.saturate()
+            result = engine.saturate(initial=snap_state)
         rec["exec_wall_s"] = round(time.time() - t0, 1)
         rec["iterations"] = int(result.iterations)
         rec["derivations"] = int(result.derivations)
+        if args.resume_from:
+            # resumed run: `derivations`/`iterations` count only the
+            # post-resume tail; *_total are cumulative across the chain
+            rec["derivations_total"] = base_derivs + int(result.derivations)
+            rec["iterations_total"] = base_iters + int(result.iterations)
         rec["converged"] = bool(result.converged)
 
         if args.oracle_budget > 0:

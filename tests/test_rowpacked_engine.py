@@ -477,3 +477,94 @@ def test_fresh_init_total_matches_live_bits():
         state = eng.initial_state()
         got = _host_bit_total(np.asarray(jax.jit(eng._live_bits)(*state)))
         assert got == expect, (type(eng).__name__, got, expect)
+
+
+# --------------------------------------------- rebind_role_closure (r5)
+
+_REBIND_BASE = (
+    # r-links that only matter once r ⊑ s lands
+    "SubClassOf(A0 ObjectSomeValuesFrom(r B0))\n"
+    "SubClassOf(A1 ObjectSomeValuesFrom(r B1))\n"
+    # s has its own link so the s-rows' CR4 chunk is LIVE at build
+    "SubClassOf(C ObjectSomeValuesFrom(s D))\n"
+    "SubClassOf(ObjectSomeValuesFrom(s B0) SHit)\n"
+    "SubClassOf(ObjectSomeValuesFrom(s D) DHit)\n"
+    "SubClassOf(B0 B0Sup)\n"
+)
+
+
+@pytest.mark.parametrize("scan", [False, True])
+def test_rebind_role_closure_matches_fresh(scan):
+    """Masks-only partial rebuild (r4 verdict task 5): growing the role
+    closure of a COMPILED engine via rebind_role_closure must reach the
+    exact closure a fresh engine built under the new closure reaches —
+    in both the unrolled-tile and scanned-chunk formulations.  No
+    chains in the corpus, so the two indexes differ ONLY in
+    role_closure and the programs are table-identical."""
+    _, idx_old = _indexed(_REBIND_BASE)
+    _, idx_new = _indexed(_REBIND_BASE + "SubObjectPropertyOf(r s)\n")
+    assert idx_old.n_roles == idx_new.n_roles
+    assert np.array_equal(idx_old.nf4, idx_new.nf4)
+    assert not np.array_equal(idx_old.role_closure, idx_new.role_closure)
+
+    kw = dict(scan_chunks=scan, window_headroom=2)
+    fresh = RowPackedSaturationEngine(idx_new, **kw).saturate()
+    eng = RowPackedSaturationEngine(idx_old, **kw)
+    before = eng.saturate()
+    # without the rebind the r-link consequence must be absent
+    a0 = idx_old.concept_ids["A0"]
+    shit = idx_old.concept_ids["SHit"]
+    assert shit not in before.subsumers(a0)
+    assert shit in fresh.subsumers(idx_new.concept_ids["A0"])
+
+    assert eng.rebind_role_closure(idx_new.role_closure)
+    # warm start from the old closure (monotone ⇒ sound)
+    resumed = eng.saturate(initial=(before.packed_s, before.packed_r))
+    assert np.array_equal(
+        np.asarray(resumed.packed_s), np.asarray(fresh.packed_s)
+    )
+    assert np.array_equal(
+        np.asarray(resumed.packed_r), np.asarray(fresh.packed_r)
+    )
+    # and from scratch too
+    cold = eng.saturate()
+    assert np.array_equal(
+        np.asarray(cold.packed_s), np.asarray(fresh.packed_s)
+    )
+
+
+def test_rebind_refuses_non_superset_and_shape():
+    _, idx = _indexed(_REBIND_BASE)
+    eng = RowPackedSaturationEngine(idx)
+    smaller = idx.role_closure[:-1, :-1]
+    assert not eng.rebind_role_closure(smaller)
+    shrunk = idx.role_closure.copy()
+    offdiag = np.argwhere(shrunk & ~np.eye(len(shrunk), dtype=bool))
+    if len(offdiag):
+        shrunk[tuple(offdiag[0])] = 0
+        assert not eng.rebind_role_closure(shrunk)
+    # identical closure: trivially true, engine untouched
+    assert eng.rebind_role_closure(idx.role_closure)
+
+
+@pytest.mark.parametrize("scan", [False, True])
+def test_rebind_refuses_revived_dead_chunk(scan):
+    """An nf4 row whose role has NO satisfying link at build time is
+    dropped from the compiled program; a closure growth that would make
+    it live must be REFUSED (the program cannot derive through rows it
+    never compiled) so the caller rebuilds."""
+    base = (
+        "SubClassOf(A0 ObjectSomeValuesFrom(r B0))\n"
+        # s has NO links anywhere: the s-rows' chunk is dead at build
+        "SubClassOf(ObjectSomeValuesFrom(s B0) SHit)\n"
+        "SubClassOf(B0 B0Sup)\n"
+    )
+    _, idx_old = _indexed(base)
+    _, idx_new = _indexed(base + "SubObjectPropertyOf(r s)\n")
+    eng = RowPackedSaturationEngine(
+        idx_old, scan_chunks=scan, window_headroom=2
+    )
+    closure_before = eng.idx.role_closure.copy()
+    assert not eng.rebind_role_closure(idx_new.role_closure)
+    # refused ⇒ untouched
+    assert np.array_equal(eng.idx.role_closure, closure_before)
