@@ -369,6 +369,28 @@ def _run_bench(load1_start: float) -> None:
         )
         extra["incremental_role_delta_new_derivations"] = rres.derivations
 
+        # closure-CHANGING role delta over the same live base (r5: the
+        # masks-only partial rebuild, verdict task 5): an r ⊑ s edge
+        # between two EXISTING BASE roles (attr7 ⊑ attr8) flips cells
+        # of the restricted role closure — previously a guaranteed full
+        # rebuild; now ``rebind_role_closure`` swaps the compiled base
+        # program's factored masks + live-window tables in place (no
+        # recompile) and attr8's ∃-axioms fire on attr7's existing
+        # links.  ``took_fast_path`` records whether the rebind fit the
+        # program's window slots (it falls back to the rebuild loudly
+        # when not); the wall is comparable against the rebuild walls
+        # below either way.
+        eng_before = inc._base_engine
+        t0 = time.time()
+        cres = inc.add_text("SubObjectPropertyOf(attr7 attr8)")
+        extra["incremental_closure_delta_fast_s"] = round(
+            time.time() - t0, 2
+        )
+        extra["incremental_closure_delta_took_fast_path"] = (
+            inc._base_engine is eng_before
+        )
+        extra["incremental_closure_delta_new_derivations"] = cres.derivations
+
         # rebuild path, BOTH walls (r3 verdict item 7: README quoted a
         # warm figure while the driver captured compile-included — ~4x
         # apart and neither labeled): cold = engine build + jit compile

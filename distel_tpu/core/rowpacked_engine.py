@@ -130,6 +130,22 @@ def _fill_window_slabs(offs_l, c01_l, nch, T):
     return offs_s, c01_s, tval_s
 
 
+def _pad_to_slots(offs, c01, slots, p_off, p_c01):
+    """Tail-pad an unrolled-mode window list to ``slots`` entries with
+    copies of the inert reserve window ``(p_off, p_c01)`` (see
+    ``_pad_window`` in ``__init__``).  The ONE pad-window encoding,
+    shared by ``build_tiles`` and ``rebind_role_closure``'s unrolled
+    branch — see :func:`_fill_window_slabs` for why sharing matters."""
+    pad = slots - len(offs)
+    if pad <= 0:
+        return offs, c01
+    offs = np.concatenate([offs, np.full(pad, p_off, np.int32)])
+    c01 = np.concatenate(
+        [c01, np.tile(np.asarray(p_c01, np.int32), (pad, 1))]
+    )
+    return offs, c01
+
+
 def _stack_span_masks(mask_tab, spans, rk):
     """[nch, rk, n_roles+1] per-chunk factored-mask slab: each kept
     span's rows tail-padded to ``rk`` with all-zero mask rows (pad rows
@@ -732,13 +748,9 @@ class RowPackedSaturationEngine:
                     dropped_roles.append(np.unique(role_of(raw)))
                     continue
                 offs, c01 = win
-                if hw:
-                    offs = np.concatenate(
-                        [offs, np.full(hw, p_off, np.int32)]
-                    )
-                    c01 = np.concatenate(
-                        [c01, np.tile(np.asarray(p_c01, np.int32), (hw, 1))]
-                    )
+                offs, c01 = _pad_to_slots(
+                    offs, c01, len(offs) + hw, p_off, p_c01
+                )
                 kept.append((raw, inv, piece))
                 tiles.append((jnp.asarray(offs), jnp.asarray(c01)))
             return kept, tiles, dropped_roles
@@ -1458,17 +1470,11 @@ class RowPackedSaturationEngine:
                     if fit is None:
                         return False
                     offs, c01 = fit
-                    pad = slots - len(offs)
-                    if pad:
-                        # inert reserve windows at the padded tail (the
-                        # tile loop's window count is static)
-                        offs = np.concatenate(
-                            [offs, np.full(pad, p_off, np.int32)]
-                        )
-                        c01 = np.concatenate([
-                            c01,
-                            np.tile(np.asarray(p_c01, np.int32), (pad, 1)),
-                        ])
+                    # inert reserve windows at the padded tail (the
+                    # tile loop's window count is static)
+                    offs, c01 = _pad_to_slots(
+                        offs, c01, slots, p_off, p_c01
+                    )
                     rebuilt.append((jnp.asarray(offs), jnp.asarray(c01)))
                 new_tiles[key] = rebuilt
             self._cr4_tiles = new_tiles["t4"]

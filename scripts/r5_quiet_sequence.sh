@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-5 quiet-window sequence (fires after the 128k r4-image probe
+# exits): official bench -> compile-posture rows (fresh-cold + cached
+# 300k, 128k, 200k) -> guaranteed-completion 64k sharded execution ->
+# 128k relaunch with snapshot/resume.  Each stage appends durable
+# artifacts; the 128k relaunch runs last because it owns the core for
+# hours and everything before it needs quiet walls.
+set -x
+cd /root/repo
+date "+%H:%M START"
+
+# 1. official bench on the quiet host (verdict tasks 2+3+5 evidence)
+timeout 5400 python bench.py > bench_r5_quiet.json 2> bench_r5_quiet.err
+date "+%H:%M BENCH DONE"
+
+# 2. sharded-table rows under the current scan+tier-3 posture
+#    (verdict tasks 4+7).  300k twice: cached redeploy, then a COLD
+#    fresh compile with the persistent cache redirected to an empty dir
+timeout 2400 python scripts/scale_probe.py 300000 --devices 8 \
+    --out SCALE_r05_probes.jsonl > probe300k_cached_r5.log 2>&1
+rm -rf /tmp/coldcache_r5 && mkdir -p /tmp/coldcache_r5
+timeout 2400 env JAX_COMPILATION_CACHE_DIR=/tmp/coldcache_r5 \
+    python scripts/scale_probe.py 300000 --devices 8 \
+    --out SCALE_r05_probes.jsonl > probe300k_cold_r5.log 2>&1
+timeout 1800 python scripts/scale_probe.py 128000 --devices 8 \
+    --out SCALE_r05_probes.jsonl > probe128k_rows_r5.log 2>&1
+timeout 1800 python scripts/scale_probe.py 200000 --devices 8 \
+    --out SCALE_r05_probes.jsonl > probe200k_rows_r5.log 2>&1
+date "+%H:%M COMPILE ROWS DONE"
+
+# 3. guaranteed-completion sharded execution ABOVE the 24k record:
+#    64k galen (~1/8 the 128k cost by the n^3 model) with the new
+#    snapshot machinery + oracle containment
+timeout 14400 python scripts/scale_probe.py 64000 --shape galen \
+    --devices 8 --execute --no-aot --oracle-budget 600 --sample 2000 \
+    --snapshot exec64k_r5.snapshot.npz \
+    --out SCALE_r05_probes.jsonl > probe64k_exec_r5.log 2>&1
+date "+%H:%M 64K EXEC DONE"
+
+# 4. the 128k relaunch (r4-verdict task 1) — snapshots every 3 rounds;
+#    runs until round teardown; resumable; progress durable
+nohup python scripts/scale_probe.py 128000 --shape galen --devices 8 \
+    --execute --no-aot --oracle-budget 600 --sample 2000 \
+    --snapshot-every 3 --snapshot exec128k_r5.snapshot.npz \
+    --out SCALE_r05_probes.jsonl > probe128k_exec_r5.log 2>&1 &
+echo "$!" > /tmp/probe128k_r5.pid
+date "+%H:%M 128K RELAUNCHED"
