@@ -124,12 +124,16 @@ def main() -> None:
     if os.path.exists("bench_r5_quiet.json"):
         bench = _lines("bench_r5_quiet.json")
         if bench:
-            doc["quiet_bench"] = {
-                "file": "bench_r5_quiet.json",
-                "contended": bench[-1].get("contended"),
-                "vs_baseline": bench[-1].get("vs_baseline"),
-                "load1_start": bench[-1].get("load1_start"),
-            }
+            doc["quiet_bench"] = {"file": "bench_r5_quiet.json"}
+            # success AND failure records must both be identifiable
+            # (a tpu_unavailable round carries failed_stage/error, not
+            # vs_baseline/contended)
+            for k in (
+                "platform", "failed_stage", "error", "attempts",
+                "contended", "vs_baseline", "load1_start", "load1",
+            ):
+                if k in bench[-1]:
+                    doc["quiet_bench"][k] = bench[-1][k]
 
     with open("SCALE_r05.json", "w") as f:
         json.dump(doc, f, indent=1)

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Exit 0 iff a COMPLETED 128k galen sharded execution record exists."""
+import json
+import sys
+
+for p in ("SCALE_r04_probes.jsonl", "SCALE_r05_probes.jsonl"):
+    try:
+        with open(p) as f:
+            for ln in f:
+                try:
+                    r = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if (
+                    r.get("n_classes") == 128000
+                    and r.get("shape") == "galen"
+                    and "derivations" in r
+                ):
+                    sys.exit(0)
+    except FileNotFoundError:
+        pass
+sys.exit(1)

@@ -10,7 +10,8 @@ cd /root/repo
 date "+%H:%M START"
 
 # 1. official bench on the quiet host (verdict tasks 2+3+5 evidence)
-timeout 5400 python bench.py > bench_r5_quiet.json 2> bench_r5_quiet.err
+BENCH_BODY_TIMEOUT_S=3600 timeout 7200 python bench.py \
+    > bench_r5_quiet.json 2> bench_r5_quiet.err
 date "+%H:%M BENCH DONE"
 
 # 2. sharded-table rows under the current scan+tier-3 posture
@@ -38,10 +39,15 @@ timeout 14400 python scripts/scale_probe.py 64000 --shape galen \
 date "+%H:%M 64K EXEC DONE"
 
 # 4. the 128k relaunch (r4-verdict task 1) — snapshots every 3 rounds;
-#    runs until round teardown; resumable; progress durable
-nohup python scripts/scale_probe.py 128000 --shape galen --devices 8 \
-    --execute --no-aot --oracle-budget 600 --sample 2000 \
-    --snapshot-every 3 --snapshot exec128k_r5.snapshot.npz \
-    --out SCALE_r05_probes.jsonl > probe128k_exec_r5.log 2>&1 &
-echo "$!" > /tmp/probe128k_r5.pid
-date "+%H:%M 128K RELAUNCHED"
+#    runs until round teardown; resumable; progress durable.  SKIPPED
+#    when the r4-image run already recorded its completion this round.
+if python scripts/has_128k_record.py; then
+  date "+%H:%M 128K ALREADY RECORDED - skipping relaunch"
+else
+  nohup python scripts/scale_probe.py 128000 --shape galen --devices 8 \
+      --execute --no-aot --oracle-budget 600 --sample 2000 \
+      --snapshot-every 3 --snapshot exec128k_r5.snapshot.npz \
+      --out SCALE_r05_probes.jsonl > probe128k_exec_r5.log 2>&1 &
+  echo "$!" > /tmp/probe128k_r5.pid
+  date "+%H:%M 128K RELAUNCHED"
+fi
