@@ -40,7 +40,32 @@ def _lines(path):
 
 
 def main() -> None:
-    doc = {"assembled": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    doc = {
+        "assembled": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "r4_image_128k_run_final_status": (
+            "the r3/r4-image 128k galen sharded execution (records only "
+            "at completion) was left running at r5 start and killed at "
+            "16:00 after 14h22m of single-core CPU (17.5h wall; ~1.5h "
+            "of r5 validation contention included) without completing — "
+            "the 5-10h cost-model band under-estimates by >=45%.  Its "
+            "replacement runs on the r5 image: durable per-round "
+            "progress + atomic resumable snapshots, so partial "
+            "execution can never be lost again (scripts/scale_probe.py "
+            "--snapshot-every/--resume-from, tests/test_runtime.py::"
+            "test_midrun_state_observer_snapshot_resume)"
+        ),
+        "tunnel_outage": (
+            "the axon TPU tunnel black-holed from ~11:57 to at least "
+            "17:20 (tunnel_health.log): the quiet official bench "
+            "recorded a structured tpu_unavailable line (bench.py's r5 "
+            "capture-proof path working as designed, BENCH r4 verdict "
+            "task 2), and the int8 Mosaic tile retry (task 9) hit the "
+            "same outage — see int8_mosaic_tile_probe / its error "
+            "records"
+        ),
+        "projection_validation": "proj_validation_r5.json (task 8: "
+        "64k->96k chain validation, +19%/-5% band, v4-8 34-43 s)",
+    }
 
     r4 = _lines("SCALE_r04_probes.jsonl")
     r5 = _lines("SCALE_r05_probes.jsonl")
