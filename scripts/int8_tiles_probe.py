@@ -1,0 +1,41 @@
+"""r5 verdict task 9: int8-in-Mosaic retry at intermediate tile shapes.
+
+r4 measured int8 jnp.dot inside the Pallas kernel SLOWER than bf16
+(23.0 vs 14.7 ms on 8k^3 tiles, default tm=512/tl=256) and tm=1024
+crashed the remote compile helper (HTTP 500).  This probes the
+intermediate shapes tm=512/768 x tl=256 for both dtypes.  Timing by
+scalar-dependent fetch (block_until_ready lies over the axon tunnel).
+"""
+import json, sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
+
+M = L = 8192
+W = 256  # 8192 packed x-bits
+rng = np.random.default_rng(0)
+a_np = (rng.random((M, L)) < 0.05).astype(np.int8)
+b_np = rng.integers(0, 2**32, size=(L, W), dtype=np.uint32)
+
+out = []
+for tm in (512, 768):
+    for dt_name in ("bf16", "int8"):
+        rec = {"tm": tm, "tl": 256, "dtype": dt_name}
+        try:
+            plan = PackedColsMatmulPlan(M, L, W, tm=tm, tl=256)
+            if dt_name == "int8":
+                plan.dtype = jnp.int8  # bypass the bf16 coercion
+            f = jax.jit(plan)
+            a = jnp.asarray(a_np); b = jnp.asarray(b_np)
+            c = f(a, b); int(c[0, 0])  # compile + sync
+            best = 1e9
+            for _ in range(5):
+                t0 = time.time(); c = f(a, b); int(c[0, 0])
+                best = min(best, time.time() - t0)
+            rec["ms"] = round(best * 1e3, 2)
+        except Exception as e:
+            rec["error"] = f"{type(e).__name__}: {e}"[:300]
+        out.append(rec)
+        print(json.dumps(rec), flush=True)
+print(json.dumps({"int8_tile_probe": out}))

@@ -1,0 +1,37 @@
+"""64k->96k projection-chain validation ratios (r5 verdict task 8).
+
+No TPU needed: engine construction is pure numpy planning, and the
+measured endpoints already exist (64k phase split in
+bench_r4_check.log, 96k walls in SCALE_r04.json slack_experiments_96k).
+This script computes the chain's scaling factors — dense-equivalent
+MAC ratio (matmul phases) and packed-state area ratio (non-matmul
+phases) — exactly as the README's 96k->300k projection uses them.
+"""
+import json, sys
+sys.path.insert(0, "/root/repo")
+from distel_tpu.owl import parser
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+
+out = {}
+for n in (64000, 96000):
+    idx = index_ontology(normalize(parser.parse(
+        snomed_shaped_ontology(n_classes=n))))
+    eng = RowPackedSaturationEngine(idx)
+    c = eng.step_cost_model()
+    out[n] = {
+        "n_concepts": idx.n_concepts,
+        "mm_dense_equiv_macs": int(c["mm_dense_equiv_macs"]),
+        "mm_live_macs": int(c["mm_live_macs"]),
+        "hbm_bytes": int(c["hbm_bytes"]),
+        "state_words": int(eng.nc + eng.nl) * int(eng.wc),
+    }
+    print(json.dumps({n: out[n]}), flush=True)
+r = {
+    "mac_ratio": out[96000]["mm_dense_equiv_macs"] / out[64000]["mm_dense_equiv_macs"],
+    "live_mac_ratio": out[96000]["mm_live_macs"] / out[64000]["mm_live_macs"],
+    "area_ratio": out[96000]["state_words"] / out[64000]["state_words"],
+}
+print("RATIOS " + json.dumps(r))
