@@ -65,6 +65,19 @@ def main() -> None:
         ),
         "projection_validation": "proj_validation_r5.json (task 8: "
         "64k->96k chain validation, +19%/-5% band, v4-8 34-43 s)",
+        "exec64k_history": (
+            "first attempt 16:30-20:30: 5 rounds recorded (iteration "
+            "10, 1,852,456 derivations, 941 MB snapshot written in "
+            "13.3 s at round 5), then killed by the orchestration's own "
+            "4-hour stage timeout 28 min into round 6 — rounds cost "
+            "~40 min each on the single-core virtual mesh, 2x the "
+            "estimate.  RESUMED 21:02 from the snapshot "
+            "(--resume-from, warm compile cache, --snapshot-every 1): "
+            "the at-scale proof of the r5 resume machinery; the 128k "
+            "relaunch was killed for it (uncached 1-hour compile for "
+            "at most one recorded round before teardown was the worse "
+            "trade)"
+        ),
     }
 
     r4 = _lines("SCALE_r04_probes.jsonl")
