@@ -133,13 +133,43 @@ def main() -> None:
             )
         doc["executed_sharded_galen_128k"] = status
 
-    # ---- 64k execution
+    # ---- 64k execution (completed record, else the durable trail)
     done_64k = [
         r for r in r5
         if r.get("n_classes") == 64000 and "derivations" in r
     ]
     if done_64k:
         doc["executed_sharded_galen_64k"] = done_64k[-1]
+    else:
+        prog = _lines("SCALE_r05_probes.jsonl.progress")
+        cur = None
+        base = 0
+        iters = []
+        for p in prog:
+            if "run_start" in p:
+                cur = p.get("n_classes")
+                base = p.get("resumed_from", {}).get("derivations", 0)
+            elif cur == 64000 and "iteration" in p:
+                q = dict(p)
+                q["derivations_total"] = base + p["derivations"]
+                iters.append(q)
+            elif cur == 64000 and "iteration_total" in p:
+                iters.append(p)
+        status = {
+            "status": "in flight at assembly time (durable trail below)",
+            "rounds_recorded": len(
+                [p for p in iters if "iteration" in p]
+            ),
+        }
+        if iters:
+            status["last_progress"] = iters[-1]
+        snap = "exec64k_r5.snapshot.npz"
+        if os.path.exists(snap):
+            status["resumable_snapshot"] = {
+                "path": snap,
+                "bytes": os.path.getsize(snap),
+            }
+        doc["executed_sharded_galen_64k_status"] = status
 
     # ---- sharded-table rows (current posture)
     rows = [
