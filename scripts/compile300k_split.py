@@ -1,0 +1,34 @@
+import sys, time, json, os
+sys.path.insert(0, "/root/repo")
+IS_CHILD = "--child" in sys.argv
+if not IS_CHILD:
+    from distel_tpu.testing.cpumesh import cpu_mesh_ready, cpu_mesh_env
+    import subprocess
+    if not cpu_mesh_ready(8):
+        env = cpu_mesh_env(8)
+        raise SystemExit(subprocess.run(
+            [sys.executable, __file__, "--child"], env=env).returncode)
+else:
+    from distel_tpu.testing.cpumesh import force_cpu_mesh
+    force_cpu_mesh(8)
+import jax, numpy as np
+from distel_tpu.config import enable_compile_cache
+enable_compile_cache()
+from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.owl import parser
+idx = index_ontology(normalize(parser.parse(snomed_shaped_ontology(n_classes=300000))))
+mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("c",))
+eng = RowPackedSaturationEngine(idx, mesh=mesh)
+sp0, rp0 = eng.initial_state()
+t0 = time.time()
+lowered = eng._run_jit(10_000 - 10_000 % eng.unroll).lower(sp0, rp0, eng._masks)
+lower_s = round(time.time() - t0, 1)
+t0 = time.time()
+lowered.compile()
+compile_s = round(time.time() - t0, 1)
+print(json.dumps({"what": "300k fresh cold split (quiet, load<0.5)",
+                  "trace_lower_s": lower_s, "xla_compile_s": compile_s,
+                  "total_s": round(lower_s + compile_s, 1)}), flush=True)
