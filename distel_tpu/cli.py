@@ -9,6 +9,8 @@
   multiply   OntologyMultiplier synthetic scaling
   diff       test-classify.sh oracle-diff verification
   bench      run-all.sh timing loop
+  serve      resident classification service (HTTP; the always-up
+             Redis-cluster analog — warm programs, delta fast path)
 
 Usage: python -m distel_tpu.cli <subcommand> [args]
 """
@@ -327,6 +329,38 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Resident classification service: keeps one IncrementalClassifier
+    per loaded ontology warm (compiled programs + device-resident
+    closure) behind a bounded-queue scheduler; see distel_tpu/serve/."""
+    from distel_tpu.config import enable_compile_cache
+    from distel_tpu.serve.server import ServeApp, serve_forever
+
+    enable_compile_cache()
+    cfg = _load_cfg(args)
+    budget = (
+        int(args.memory_budget_mb * (1 << 20))
+        if args.memory_budget_mb is not None
+        else None
+    )
+    app = ServeApp(
+        cfg,
+        workers=args.workers,
+        max_queue=args.max_queue,
+        max_batch=args.max_batch,
+        deadline_s=args.deadline_s,
+        memory_budget_bytes=budget,
+        spill_dir=args.spill_dir,
+        fast_path_min_concepts=args.fast_path_min_concepts,
+    )
+    spilled = serve_forever(app, args.host, args.port)
+    print(
+        json.dumps({"shutdown": "graceful", "spilled": spilled}),
+        flush=True,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="distel_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -390,6 +424,32 @@ def main(argv=None) -> int:
     d = sub.add_parser("diff", help="verify against the CPU oracle")
     d.add_argument("ontology")
     d.set_defaults(fn=cmd_diff)
+
+    sv = sub.add_parser(
+        "serve", help="resident classification service (HTTP)"
+    )
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8080,
+                    help="0 binds an ephemeral port (printed at startup)")
+    sv.add_argument("--config", help="properties/config file")
+    sv.add_argument("--workers", type=int, default=2,
+                    help="scheduler workers (cross-ontology concurrency)")
+    sv.add_argument("--max-queue", type=int, default=64,
+                    help="bounded admission queue; overflow answers 429")
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="max queued deltas coalesced into one increment")
+    sv.add_argument("--deadline-s", type=float, default=300.0,
+                    help="default per-request deadline (503 past it)")
+    sv.add_argument("--memory-budget-mb", type=float, default=None,
+                    help="resident-closure budget; LRU ontologies spill "
+                         "to --spill-dir past it")
+    sv.add_argument("--spill-dir", default=None,
+                    help="snapshot directory for eviction + graceful "
+                         "shutdown (required with --memory-budget-mb)")
+    sv.add_argument("--fast-path-min-concepts", type=int, default=None,
+                    help="override the delta fast path's base-size "
+                         "cutoff (default ~32k; 0 forces it everywhere)")
+    sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("bench", help="timing loop on one ontology")
     b.add_argument("ontology")

@@ -136,7 +136,13 @@ class IncrementalClassifier:
         state, self._state = self._state, None
         return state
 
-    def add_ontology(self, onto) -> SaturationResult:
+    def _ingest(self, onto):
+        """Frontend half of an increment: normalize the batch under the
+        persistent caches (gensym memo, range state), merge it into the
+        accumulated corpus, and re-index with the append-only
+        ``Indexer``.  No saturation — split out so ``restore`` can
+        replay a spilled classifier's numbering without re-deriving its
+        closure.  Returns ``(idx, batch)``."""
         normalizer = Normalizer(
             cache=self._normalizer_cache, range_state=self._range_state
         )
@@ -153,9 +159,12 @@ class IncrementalClassifier:
             r: normalizer.effective_ranges(r)
             for r in self.accumulated.roles()
         }
+        return self.indexer.index(self.accumulated), batch
 
-        idx = self.indexer.index(self.accumulated)
+    def add_ontology(self, onto) -> SaturationResult:
+        idx, batch = self._ingest(onto)
         result = self._delta_fast_path(idx)
+        path = "fast" if result is not None else "rebuild"
         if result is None:
             result = self._full_rebuild(idx)
         if result.transposed:
@@ -173,10 +182,81 @@ class IncrementalClassifier:
                 "batch_axioms": batch.axiom_count(),
                 "iterations": result.iterations,
                 "new_derivations": result.derivations,
+                # which saturation plane served the increment — the
+                # serve layer's fast-path-vs-rebuild ratio comes from
+                # here ("fast": base program reused; "rebuild": fresh
+                # compile)
+                "path": path,
             }
         )
         self.last_result = result
         return result
+
+    # --------------------------------------------------- spill / restore
+
+    def snapshot(self, path: str, compressed: bool = True) -> None:
+        """Spill the running closure to disk (``runtime/checkpoint``'s
+        ``.npz`` wire form) — the serve plane's LRU-eviction and
+        graceful-shutdown artifact.  Restore with :meth:`restore`."""
+        from distel_tpu.runtime.checkpoint import save_snapshot
+
+        if self.last_result is None:
+            raise ValueError(
+                "nothing to snapshot: no increment has completed"
+            )
+        save_snapshot(path, self.last_result, compressed=compressed)
+
+    @classmethod
+    def restore(
+        cls,
+        texts: List[str],
+        snapshot_path: str,
+        config: Optional[ClassifierConfig] = None,
+    ) -> "IncrementalClassifier":
+        """Rebuild a live classifier from its spilled closure.
+
+        ``texts`` are the ontology texts previously fed to
+        :meth:`add_text`, in order; replaying them through the FRONTEND
+        only (parse → normalize → index — no saturation) reconstructs
+        the persistent caches and the exact append-only numbering the
+        snapshot was taken under, so the spilled state re-embeds as an
+        identity remap.  One full rebuild then warm-starts from the
+        embedded closure; monotone EL+ saturation makes it a converged
+        start, so the fixed point terminates after one quiet pass and
+        the restored classifier is ready for further deltas (with a
+        fresh compiled base program for the fast path)."""
+        from distel_tpu.runtime.checkpoint import load_snapshot_state
+
+        inc = cls(config)
+        idx = None
+        for text in texts:
+            idx, _ = inc._ingest(owl_loader.load(text))
+            inc.increment += 1
+        if idx is None:
+            raise ValueError("restore needs at least one replayed text")
+        # wire-packed state for the row-packed engine (identity remap
+        # under the replayed numbering); densify for reference engines
+        unpack = config is not None and config.engine in ("packed", "dense")
+        state, info = load_snapshot_state(
+            snapshot_path, idx=idx, unpack=unpack
+        )
+        inc._state = state
+        result = inc._full_rebuild(idx)
+        if result.transposed:
+            inc._state = (result.packed_s, result.packed_r)
+        else:
+            inc._state = (result.s, result.r)
+        inc.history.append(
+            {
+                "increment": inc.increment,
+                "restored_from": snapshot_path,
+                "iterations": result.iterations,
+                "new_derivations": result.derivations,
+                "path": "restore",
+            }
+        )
+        inc.last_result = result
+        return inc
 
     def _full_rebuild(self, idx) -> SaturationResult:
         """Compile a fresh engine for the whole accumulated corpus (with
@@ -249,8 +329,15 @@ class IncrementalClassifier:
           dual score cursors ``Type3_2AxiomProcessor.java:99-106``).
 
         The programs round-robin with the base program to a joint fixed
-        point.  Deltas that add roles, change the role hierarchy, or
-        overflow a padding reservation take the full-rebuild path."""
+        point.  Role-hierarchy-growing deltas (new roles, and ``r ⊑ s``
+        between EXISTING roles) also stay on the fast path: new roles
+        are invisible to the base program by construction, and a grown
+        closure between base roles is swapped into the compiled program
+        by ``rebind_role_closure``'s masks-only partial rebuild (no
+        recompile).  Only deltas the rebind structurally cannot express
+        (a build-time-dead chunk revived, window slots exhausted) or
+        that overflow a padding reservation take the full-rebuild
+        path."""
         base, b = self._base_engine, self._base_idx
         if base is None or self._state is None:
             return None

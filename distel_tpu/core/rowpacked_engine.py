@@ -157,6 +157,18 @@ def _stack_span_masks(mask_tab, spans, rk):
     ])
 
 
+def _chunk_spans(n_rows, rk):
+    """Row spans ``[(a0, a1), ...]`` of a role-sorted CR4/CR6 table
+    split into exactly-``rk``-row chunks — THE span decomposition shared
+    by ``build_scan`` (which compiles one padded slab per live span) and
+    ``rebind_role_closure`` (which re-derives span liveness for rules
+    the program never compiled).  Both sides must iterate identical
+    spans: if they desynchronized, the rebind would misjudge which table
+    rows the compiled program carries and could bless a closure the
+    program cannot derive under (silent under-derivation)."""
+    return [(a0, min(a0 + rk, n_rows)) for a0 in range(0, n_rows, rk)]
+
+
 def _pos_maps(writers, n_rows):
     """Layered row → concat-position maps; position ``sentinel`` indexes
     a trailing always-False slot.  Rows written by k writers occupy k
@@ -772,8 +784,7 @@ class RowPackedSaturationEngine:
             indices into the rule's change-source vector (S-row mask for
             CR4, dirty_l for CR6; pad = the appended always-False slot),
             folded to a per-chunk dirty scalar by one vectorized gather."""
-            K = len(tab_roles)
-            spans = [(o, min(o + rk, K)) for o in range(0, K, rk)]
+            spans = _chunk_spans(len(tab_roles), rk)
             rows_l, fdx_l = [], []
             offs_l, c01_l, tgt_l, reader_rows = [], [], [], []
             spans_kept, spans_dropped = [], []
@@ -1394,9 +1405,7 @@ class RowPackedSaturationEngine:
                     if tab_roles is not None and len(tab_roles):
                         rk = self._scan_rk[0 if key == "s4" else 1]
                         lcn = self.lc4 if key == "s4" else self.lc
-                        K = len(tab_roles)
-                        for a0 in range(0, K, rk):
-                            a1 = min(a0 + rk, K)
+                        for a0, a1 in _chunk_spans(len(tab_roles), rk):
                             if self._live_windows(
                                 tab_roles[a0:a1], lcn, h_arg=h_new
                             ) is not None:

@@ -48,6 +48,49 @@ class PhaseTimer:
         return "\n".join(lines)
 
 
+class PhaseAggregate:
+    """Aggregate many :class:`PhaseTimer` runs (or ad-hoc phase
+    observations) into per-phase ``count / total_s / max_s`` — the
+    bridge from the one-shot classify() tracer to a *resident* service's
+    counters.  The serve plane times every request's pipeline stages
+    (queue wait, saturate, taxonomy, ...) with a ``PhaseTimer``, absorbs
+    it here, and renders the aggregate as Prometheus summaries
+    (``distel_tpu/serve/metrics.py``).  Thread-safe: absorbed from
+    concurrent scheduler workers."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        #: phase name → [count, total seconds, max seconds]
+        self._phases: Dict[str, List[float]] = {}
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            acc = self._phases.setdefault(name, [0, 0.0, 0.0])
+            acc[0] += 1
+            acc[1] += seconds
+            acc[2] = max(acc[2], seconds)
+
+    def absorb(self, timer: PhaseTimer, prefix: str = "") -> None:
+        """Fold one finished timer's phases in (each phase counts once:
+        the timer already sums re-entries)."""
+        for name, total in timer.phases.items():
+            self.observe(prefix + name, total)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """{phase: {count, total_s, max_s}} — a consistent copy."""
+        with self._lock:
+            return {
+                name: {
+                    "count": int(c),
+                    "total_s": t,
+                    "max_s": mx,
+                }
+                for name, (c, t, mx) in self._phases.items()
+            }
+
+
 @contextlib.contextmanager
 def trace_to(log_dir: Optional[str]):
     """Optional XLA profiler capture around the saturation loop — the

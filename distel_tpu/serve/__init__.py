@@ -1,0 +1,44 @@
+"""The serving plane — DistEL as a *resident* system.
+
+The reference is not a batch job: Redis stays up, the traffic-data
+scenario (``scripts/traffic-data-load-classify.sh``) streams deltas at a
+live closure, and workers answer continuously.  This package is the
+TPU-native analog: a stdlib-only HTTP service that keeps compiled
+programs and device-resident closures warm across requests instead of
+paying parse+compile per invocation.
+
+Layout::
+
+    registry.py   warm-program registry — one IncrementalClassifier per
+                  loaded ontology, LRU eviction under a memory budget
+                  with snapshot-to-disk spill (runtime/checkpoint)
+    scheduler.py  bounded-queue request scheduler — per-ontology
+                  serialization, cross-ontology concurrency, delta
+                  batching, admission control, deadlines
+    metrics.py    Prometheus-text counters/gauges/summaries over the
+                  registry/scheduler/instrumentation signals
+    server.py     ThreadingHTTPServer app: the /v1 endpoints, /healthz,
+                  /metrics, graceful SIGTERM shutdown with final spill
+    client.py     tiny stdlib client (urllib) used by the tests
+
+Entry point: ``python -m distel_tpu.cli serve --port 8080``.
+"""
+
+from distel_tpu.serve.registry import OntologyRegistry
+from distel_tpu.serve.scheduler import (
+    Deadline,
+    QueueFull,
+    RequestScheduler,
+    ShuttingDown,
+)
+from distel_tpu.serve.server import ServeApp, make_server
+
+__all__ = [
+    "Deadline",
+    "OntologyRegistry",
+    "QueueFull",
+    "RequestScheduler",
+    "ServeApp",
+    "ShuttingDown",
+    "make_server",
+]

@@ -1,0 +1,107 @@
+"""Tiny stdlib client for the serve plane (urllib, no new deps).
+
+Used by the tests and handy from a REPL::
+
+    from distel_tpu.serve.client import ServeClient
+    c = ServeClient("http://127.0.0.1:8080")
+    oid = c.load(open("snomed.ofn").read())["id"]
+    c.delta(oid, "SubClassOf(Extra Find3)")
+    c.subsumers(oid, "Extra")
+
+Non-2xx responses raise :class:`ServeError` carrying the HTTP status,
+the parsed error body, and the response headers (tests assert on 429's
+``Retry-After``).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class ServeError(Exception):
+    def __init__(self, status: int, body, headers=None):
+        message = (
+            body.get("error") if isinstance(body, dict) else str(body)
+        )
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.body = body
+        self.headers = dict(headers or {})
+
+
+class ServeClient:
+    def __init__(self, base_url: str, timeout: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- http
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        doc: Optional[dict] = None,
+        deadline_s: Optional[float] = None,
+    ):
+        url = self.base_url + path
+        data = json.dumps(doc).encode("utf-8") if doc is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if deadline_s is not None:
+            req.add_header("X-Distel-Deadline-S", str(deadline_s))
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+                if ctype.startswith("application/json"):
+                    return json.loads(raw.decode("utf-8"))
+                return raw.decode("utf-8")
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                body = json.loads(raw.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                body = raw.decode("utf-8", "replace")
+            raise ServeError(e.code, body, e.headers) from None
+
+    # -------------------------------------------------------------- API
+
+    def load(self, text: str, deadline_s: Optional[float] = None) -> dict:
+        return self._request(
+            "POST", "/v1/ontologies", {"text": text}, deadline_s
+        )
+
+    def delta(
+        self, oid: str, text: str, deadline_s: Optional[float] = None
+    ) -> dict:
+        return self._request(
+            "POST", f"/v1/ontologies/{oid}/deltas", {"text": text},
+            deadline_s,
+        )
+
+    def subsumers(
+        self, oid: str, cls: str, deadline_s: Optional[float] = None
+    ) -> dict:
+        from urllib.parse import quote
+
+        return self._request(
+            "GET",
+            f"/v1/ontologies/{oid}/subsumers?class={quote(cls)}",
+            None,
+            deadline_s,
+        )
+
+    def taxonomy(self, oid: str, deadline_s: Optional[float] = None) -> dict:
+        return self._request(
+            "GET", f"/v1/ontologies/{oid}/taxonomy", None, deadline_s
+        )
+
+    def healthz(self) -> dict:
+        return self._request("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        return self._request("GET", "/metrics")

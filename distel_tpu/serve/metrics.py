@@ -1,0 +1,187 @@
+"""Prometheus-text metrics for the serve plane (stdlib only).
+
+A deliberately small subset of the Prometheus client model — counters,
+gauges (value or callable), histograms with fixed buckets, plus a
+renderer for ``PhaseAggregate`` (``runtime/instrumentation.py``) as
+summaries — enough for the ops signals the resident service needs
+(request rates, queue depth, batch sizes, fast-path vs rebuild ratio,
+evictions) without a dependency.  Rendered in text exposition format
+(version 0.0.4) by :meth:`Metrics.render`.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Callable, Dict, Optional, Tuple, Union
+
+#: request-latency buckets (seconds): sub-10 ms queries through
+#: multi-minute saturations
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0,
+)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: _LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class Metrics:
+    """Thread-safe metric registry.  All mutators are cheap (dict upsert
+    under one lock) — safe on the request path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: name → {labels_key → value}
+        self._counters: Dict[str, Dict[_LabelKey, float]] = {}
+        #: name → value | zero-arg callable (sampled at render time)
+        self._gauges: Dict[str, Union[float, Callable[[], float]]] = {}
+        #: name → (buckets, {labels_key → [bucket_counts, sum, count]})
+        self._hists: Dict[str, tuple] = {}
+        self._help: Dict[str, str] = {}
+
+    # ------------------------------------------------------------ write
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help[name] = help_text
+
+    def counter_inc(
+        self,
+        name: str,
+        labels: Optional[Dict[str, str]] = None,
+        value: float = 1.0,
+    ) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + value
+
+    def counter_value(
+        self, name: str, labels: Optional[Dict[str, str]] = None
+    ) -> float:
+        with self._lock:
+            return self._counters.get(name, {}).get(_labels_key(labels), 0.0)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge_fn(self, name: str, fn: Callable[[], float]) -> None:
+        """Register a live-sampled gauge (e.g. queue depth): called at
+        render time, so the scrape always sees the current value."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[Dict[str, str]] = None,
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            bks, series = self._hists.setdefault(
+                name, (tuple(buckets), {})
+            )
+            acc = series.get(key)
+            if acc is None:
+                acc = series[key] = [[0] * len(bks), 0.0, 0]
+            counts, _, _ = acc
+            # per-bucket storage (render cumulates into le-buckets)
+            for i, b in enumerate(bks):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            acc[1] += value
+            acc[2] += 1
+
+    # ------------------------------------------------------------- read
+
+    def render(self, phase_aggregate=None) -> str:
+        """Text exposition format.  ``phase_aggregate``: an optional
+        ``PhaseAggregate`` rendered as per-phase summaries
+        (``distel_request_phase_seconds{phase=...}``)."""
+        with self._lock:
+            counters = {
+                n: dict(s) for n, s in sorted(self._counters.items())
+            }
+            gauges = dict(sorted(self._gauges.items()))
+            hists = {
+                n: (b, {k: (list(c), s, cnt) for k, (c, s, cnt) in se.items()})
+                for n, (b, se) in sorted(self._hists.items())
+            }
+            helps = dict(self._help)
+        lines = []
+        for name, series in counters.items():
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} counter")
+            for key, v in sorted(series.items()):
+                lines.append(f"{name}{_fmt_labels(key)} {_fmt_value(v)}")
+        for name, v in gauges.items():
+            if callable(v):
+                try:
+                    v = float(v())
+                except Exception:  # a dying gauge must not kill /metrics
+                    continue
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt_value(v)}")
+        for name, (bks, series) in hists.items():
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} histogram")
+            for key, (counts, total, cnt) in sorted(series.items()):
+                cum = 0
+                for b, c in zip(bks, counts):
+                    cum += c
+                    le = 'le="%s"' % _fmt_value(b)
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(key, le)} {cum}"
+                    )
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(key, inf)} {cnt}"
+                )
+                lines.append(
+                    f"{name}_sum{_fmt_labels(key)} {_fmt_value(total)}"
+                )
+                lines.append(f"{name}_count{_fmt_labels(key)} {cnt}")
+        if phase_aggregate is not None:
+            snap = phase_aggregate.snapshot()
+            if snap:
+                nm = "distel_request_phase_seconds"
+                lines.append(
+                    f"# HELP {nm} per-request pipeline phase wall time"
+                )
+                lines.append(f"# TYPE {nm} summary")
+                for phase, acc in sorted(snap.items()):
+                    lab = _fmt_labels(_labels_key({"phase": phase}))
+                    lines.append(
+                        f"{nm}_sum{lab} {_fmt_value(acc['total_s'])}"
+                    )
+                    lines.append(f"{nm}_count{lab} {acc['count']}")
+                    mlab = _fmt_labels(_labels_key({"phase": phase}))
+                    lines.append(
+                        f"{nm}_max{mlab} {_fmt_value(acc['max_s'])}"
+                    )
+        return "\n".join(lines) + "\n"

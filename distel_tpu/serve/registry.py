@@ -1,0 +1,324 @@
+"""Warm-program ontology registry.
+
+One :class:`~distel_tpu.core.incremental.IncrementalClassifier` per
+loaded ontology, kept *resident*: the compiled base program, the
+persistent normalizer/indexer caches, and the device-resident packed
+closure all survive across requests — the serving analog of the
+reference's always-up Redis stores (SURVEY.md §5).  Under a configurable
+memory budget the registry evicts least-recently-used ontologies by
+spilling their closure to disk (``runtime/checkpoint`` ``.npz`` wire
+form) and keeping the raw ontology texts; a later request transparently
+restores the classifier (frontend replay + warm-start rebuild,
+``IncrementalClassifier.restore``).
+
+Concurrency contract: the scheduler serializes requests *per ontology*,
+so an entry's classifier is only ever driven by one worker at a time;
+the registry's own lock covers only the map/LRU bookkeeping, and
+eviction skips entries whose per-entry lock is held (a busy ontology is
+never spilled mid-request).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from distel_tpu.config import ClassifierConfig
+
+
+class UnknownOntology(KeyError):
+    """No ontology registered under this id."""
+
+
+class _Entry:
+    __slots__ = (
+        "oid", "inc", "texts", "resident_bytes", "last_used",
+        "spill_path", "lock",
+    )
+
+    def __init__(self, oid: str):
+        self.oid = oid
+        self.inc = None  # IncrementalClassifier when resident
+        self.texts: List[str] = []
+        self.resident_bytes = 0
+        self.last_used = time.monotonic()
+        self.spill_path: Optional[str] = None
+        self.lock = threading.RLock()
+
+
+def _state_bytes(inc) -> int:
+    """Resident footprint estimate: the packed closure pair (device or
+    host).  The compiled program and index tables ride along uncounted —
+    the closure dominates at serving scale."""
+    state = inc._state
+    if state is None:
+        return 0
+    return int(
+        getattr(state[0], "nbytes", 0) + getattr(state[1], "nbytes", 0)
+    )
+
+
+class OntologyRegistry:
+    def __init__(
+        self,
+        config: Optional[ClassifierConfig] = None,
+        *,
+        memory_budget_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        metrics=None,
+        fast_path_min_concepts: Optional[int] = None,
+    ):
+        self.config = config or ClassifierConfig()
+        self.memory_budget_bytes = memory_budget_bytes
+        self.spill_dir = spill_dir
+        self.metrics = metrics
+        #: ops override of the fast path's scale cutoff (the compiled
+        #: base program only pays off past ~32k concepts; a test or a
+        #: small-corpus deployment sets 0 to force it)
+        self.fast_path_min_concepts = fast_path_min_concepts
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self._seq = 0
+        if memory_budget_bytes is not None and spill_dir is None:
+            raise ValueError(
+                "a memory budget needs a spill_dir to evict into"
+            )
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    # ---------------------------------------------------------- helpers
+
+    def _count(self, name: str, **labels) -> None:
+        if self.metrics is not None:
+            self.metrics.counter_inc(name, labels or None)
+
+    def _new_inc(self):
+        from distel_tpu.core.incremental import IncrementalClassifier
+
+        inc = IncrementalClassifier(self.config)
+        if self.fast_path_min_concepts is not None:
+            inc._FAST_PATH_MIN_CONCEPTS = self.fast_path_min_concepts
+        return inc
+
+    def _entry(self, oid: str) -> _Entry:
+        with self._lock:
+            entry = self._entries.get(oid)
+        if entry is None:
+            raise UnknownOntology(oid)
+        return entry
+
+    def new_id(self) -> str:
+        """Reserve an ontology id (the scheduler needs the key *before*
+        the load executes, so per-key serialization covers the load
+        itself)."""
+        with self._lock:
+            self._seq += 1
+            return f"ont-{self._seq:04d}"
+
+    # ------------------------------------------------------------- API
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+        resident = [e for e in entries if e.inc is not None]
+        return {
+            "ontologies": len(entries),
+            "resident": len(resident),
+            "spilled": len(entries) - len(resident),
+            "resident_bytes": sum(e.resident_bytes for e in resident),
+            "memory_budget_bytes": self.memory_budget_bytes,
+        }
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                e.resident_bytes
+                for e in self._entries.values()
+                if e.inc is not None
+            )
+
+    def load(self, oid: str, text: str) -> dict:
+        """Load+classify a new ontology under a reserved id."""
+        with self._lock:
+            if oid in self._entries:
+                raise ValueError(f"ontology id already loaded: {oid}")
+            entry = self._entries[oid] = _Entry(oid)
+        try:
+            with entry.lock:
+                inc = self._new_inc()
+                result = inc.add_text(text)
+                entry.inc = inc
+                entry.texts.append(text)
+                entry.resident_bytes = _state_bytes(inc)
+                entry.last_used = time.monotonic()
+        except BaseException:
+            # a failed load must not leave a zombie id behind (listed by
+            # /healthz, un-restorable, growing the map on every retry)
+            with self._lock:
+                self._entries.pop(oid, None)
+            raise
+        self._note_path(inc)
+        self._maybe_evict(keep=oid)
+        rec = dict(inc.history[-1])
+        rec.update(
+            id=oid,
+            concepts=result.idx.n_concepts,
+            links=result.idx.n_links,
+            roles=result.idx.n_roles,
+        )
+        return rec
+
+    def delta(self, oid: str, texts: List[str]) -> dict:
+        """Apply one or more delta texts as ONE increment (the
+        scheduler's batching path: deltas are order-dependent per
+        ontology, and a coalesced batch saturates once — monotone EL+
+        makes the merged batch's closure identical to applying them in
+        sequence)."""
+        from distel_tpu.owl import loader as owl_loader
+
+        entry = self._entry(oid)
+        with entry.lock:
+            inc = self._resident(entry)
+            text = "\n".join(texts)
+            # parse FIRST (the common failure, and it mutates nothing),
+            # then record the text BEFORE saturating: add_ontology
+            # merges the batch into the accumulated corpus up front, so
+            # if the saturation itself fails the classifier has still
+            # ingested the axioms (the next successful increment
+            # derives them) — texts must agree with the corpus or a
+            # later spill/restore would silently replay a smaller
+            # ontology than the one the closure answers for
+            onto = owl_loader.load(text)
+            entry.texts.append(text)
+            result = inc.add_ontology(onto)
+            entry.resident_bytes = _state_bytes(inc)
+            entry.last_used = time.monotonic()
+        self._note_path(inc)
+        self._maybe_evict(keep=oid)
+        rec = dict(inc.history[-1])
+        rec.update(id=oid, batched=len(texts), concepts=result.idx.n_concepts)
+        return rec
+
+    def classifier(self, oid: str):
+        """The resident classifier for a query (restores from spill if
+        evicted).  Caller must hold the scheduler's per-ontology
+        serialization (queries ride the same lane as deltas)."""
+        entry = self._entry(oid)
+        with entry.lock:
+            inc = self._resident(entry)
+            entry.last_used = time.monotonic()
+            return inc
+
+    # ------------------------------------------------------ spill plane
+
+    def _resident(self, entry: _Entry):
+        """Entry's classifier, restoring from the spill file when the
+        entry was evicted.  Caller holds ``entry.lock``."""
+        if entry.inc is not None:
+            return entry.inc
+        from distel_tpu.core.incremental import IncrementalClassifier
+
+        t0 = time.monotonic()
+        inc = IncrementalClassifier.restore(
+            entry.texts, entry.spill_path, self.config
+        )
+        if self.fast_path_min_concepts is not None:
+            inc._FAST_PATH_MIN_CONCEPTS = self.fast_path_min_concepts
+        entry.inc = inc
+        entry.resident_bytes = _state_bytes(inc)
+        self._count("distel_registry_restores_total")
+        if self.metrics is not None:
+            self.metrics.observe(
+                "distel_registry_restore_seconds",
+                time.monotonic() - t0,
+            )
+        self._maybe_evict(keep=entry.oid)
+        return inc
+
+    def _spill_path(self, oid: str) -> str:
+        return os.path.join(self.spill_dir, f"{oid}.snapshot.npz")
+
+    def _spill(self, entry: _Entry) -> Optional[str]:
+        """Snapshot the entry's closure and drop the classifier.  Caller
+        holds ``entry.lock``."""
+        if entry.inc is None:
+            return entry.spill_path
+        path = self._spill_path(entry.oid)
+        # uncompressed: eviction sits on the request path, and zlib on a
+        # multi-GB closure costs minutes (same call as scale_probe's
+        # mid-run snapshots)
+        entry.inc.snapshot(path, compressed=False)
+        entry.spill_path = path
+        entry.inc = None
+        entry.resident_bytes = 0
+        return path
+
+    def _maybe_evict(self, keep: Optional[str] = None) -> None:
+        """Spill LRU entries until the resident closures fit the budget.
+        Never evicts ``keep`` (the entry just touched) and never blocks
+        on a busy entry's lock — a concurrent request beats a byte
+        target."""
+        if self.memory_budget_bytes is None:
+            return
+        while True:
+            with self._lock:
+                # total counts EVERY resident closure (keep included);
+                # keep is only exempt from victim selection
+                total = sum(
+                    e.resident_bytes
+                    for e in self._entries.values()
+                    if e.inc is not None
+                )
+                victims = [
+                    e
+                    for e in self._entries.values()
+                    if e.inc is not None and e.oid != keep
+                ]
+                if total <= self.memory_budget_bytes or not victims:
+                    return
+                victim = min(victims, key=lambda e: e.last_used)
+            if not victim.lock.acquire(blocking=False):
+                return  # busy: let the in-flight request finish first
+            try:
+                if victim.inc is None:
+                    continue  # raced with another evictor
+                self._spill(victim)
+                self._count("distel_registry_evictions_total")
+            finally:
+                victim.lock.release()
+
+    def spill_all(self) -> List[str]:
+        """Graceful-shutdown hook: snapshot every resident ontology so a
+        restarted server restores instead of re-classifying.  Returns
+        the spill paths written."""
+        if not self.spill_dir:
+            return []
+        with self._lock:
+            entries = list(self._entries.values())
+        paths = []
+        for entry in entries:
+            with entry.lock:
+                if entry.inc is None:
+                    continue
+                paths.append(self._spill(entry))
+                self._count("distel_registry_shutdown_spills_total")
+        return paths
+
+    # ---------------------------------------------------------- metrics
+
+    def _note_path(self, inc) -> None:
+        """Bump the fast-path / rebuild counters from the increment the
+        classifier just recorded."""
+        if self.metrics is None or not inc.history:
+            return
+        path = inc.history[-1].get("path")
+        if path == "fast":
+            self._count("distel_deltas_fast_path_total")
+        elif path == "rebuild":
+            self._count("distel_saturation_rebuilds_total")

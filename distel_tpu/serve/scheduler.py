@@ -1,0 +1,272 @@
+"""Bounded-queue request scheduler for the serve plane.
+
+Shapes (the robustness contract the resident service carries for the
+whole stack):
+
+* **per-ontology serialization** — deltas are order-dependent, and the
+  registry's classifiers are single-writer; all requests for one
+  ontology run in admission order on one lane;
+* **cross-ontology concurrency** — a small worker pool drains distinct
+  lanes in parallel (the closures are independent device programs);
+* **delta batching** — contiguous batchable requests at the head of a
+  lane coalesce into ONE executor call (one saturation for k queued
+  deltas — the tensor analog of the reference absorbing a burst of
+  Redis inserts into one increment);
+* **admission control** — a full queue rejects at submit
+  (:class:`QueueFull` → HTTP 429 + Retry-After) instead of queueing
+  unboundedly;
+* **deadlines** — a request that expires while queued is failed with
+  :class:`Deadline` (→ 503) without ever occupying a worker; a request
+  that expires mid-execution returns 503 to the *waiter* while the
+  worker finishes the (uninterruptible) device program and recovers.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class QueueFull(Exception):
+    """Admission refused: the bounded queue is at capacity."""
+
+
+class ShuttingDown(Exception):
+    """Admission refused: the scheduler is draining for shutdown."""
+
+
+class Deadline(Exception):
+    """The request's deadline passed before a result was produced."""
+
+
+class Request:
+    """A scheduled unit.  ``wait`` blocks the HTTP handler thread; the
+    worker resolves via ``_resolve``/``_fail``."""
+
+    __slots__ = (
+        "key", "kind", "payload", "deadline", "enqueued", "batchable",
+        "_event", "_result", "_error", "batched",
+    )
+
+    def __init__(self, key, kind, payload, deadline, batchable=False):
+        self.key = key
+        self.kind = kind
+        self.batchable = batchable
+        self.payload = payload
+        self.deadline = deadline
+        self.enqueued = time.monotonic()
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self.batched = 1
+
+    def _resolve(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def wait(self, timeout: Optional[float]):
+        """Result, or raises the worker's error; raises
+        :class:`Deadline` when ``timeout`` elapses first (the worker
+        keeps running — device programs are uninterruptible — and its
+        late result is discarded)."""
+        if not self._event.wait(timeout):
+            raise Deadline(
+                f"request exceeded its deadline after {timeout:.3g}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class RequestScheduler:
+    """``execute(key, kind, payloads) -> result`` is the single executor
+    callback (the server routes it into the registry); for a coalesced
+    batch it receives every payload and its result is shared by all
+    requests in the batch."""
+
+    def __init__(
+        self,
+        execute: Callable[[str, str, List], object],
+        *,
+        workers: int = 2,
+        max_queue: int = 64,
+        max_batch: int = 8,
+        metrics=None,
+    ):
+        if workers < 1 or max_queue < 1 or max_batch < 1:
+            raise ValueError("workers, max_queue, max_batch must be >= 1")
+        self._execute = execute
+        self.max_queue = max_queue
+        self.max_batch = max_batch
+        self.metrics = metrics
+        self._cv = threading.Condition()
+        #: key → FIFO of queued requests (admission order per lane)
+        self._lanes: Dict[str, collections.deque] = {}
+        #: lane admission order across keys (approximate global FIFO)
+        self._order: collections.deque = collections.deque()
+        self._active: set = set()  # keys currently on a worker
+        self._depth = 0  # queued (not yet executing) requests
+        self._stopping = False
+        self._workers = [
+            threading.Thread(
+                target=self._worker_loop, name=f"distel-serve-{i}",
+                daemon=True,
+            )
+            for i in range(workers)
+        ]
+        for t in self._workers:
+            t.start()
+
+    # ---------------------------------------------------------- metrics
+
+    def depth(self) -> int:
+        with self._cv:
+            return self._depth
+
+    def active(self) -> int:
+        with self._cv:
+            return len(self._active)
+
+    # --------------------------------------------------------- frontend
+
+    def submit(
+        self,
+        key: str,
+        kind: str,
+        payload,
+        *,
+        deadline_s: Optional[float] = None,
+        batchable: bool = False,
+    ) -> Request:
+        """Admit a request onto ``key``'s lane, or raise
+        :class:`QueueFull` / :class:`ShuttingDown`."""
+        deadline = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        req = Request(key, kind, payload, deadline, batchable)
+        with self._cv:
+            if self._stopping:
+                raise ShuttingDown("scheduler is draining")
+            if self._depth >= self.max_queue:
+                if self.metrics is not None:
+                    self.metrics.counter_inc("distel_admission_rejected_total")
+                raise QueueFull(
+                    f"queue full ({self._depth}/{self.max_queue})"
+                )
+            lane = self._lanes.get(key)
+            if lane is None:
+                lane = self._lanes[key] = collections.deque()
+            lane.append(req)
+            if key not in self._order:
+                self._order.append(key)
+            self._depth += 1
+            self._cv.notify()
+        return req
+
+    # ----------------------------------------------------------- worker
+
+    def _pick(self) -> Optional[str]:
+        """A key with queued work whose lane is idle (caller holds the
+        lock)."""
+        for key in self._order:
+            if key not in self._active and self._lanes.get(key):
+                return key
+        return None
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                key = self._pick()
+                while key is None:
+                    if self._stopping:
+                        return
+                    self._cv.wait()
+                    key = self._pick()
+                lane = self._lanes[key]
+                batch = [lane.popleft()]
+                # coalesce contiguous batchable requests of the same kind
+                while (
+                    lane
+                    and len(batch) < self.max_batch
+                    and batch[0].batchable
+                    and lane[0].batchable
+                    and lane[0].kind == batch[0].kind
+                ):
+                    batch.append(lane.popleft())
+                self._depth -= len(batch)
+                if not lane:
+                    self._lanes.pop(key, None)
+                    try:
+                        self._order.remove(key)
+                    except ValueError:
+                        pass
+                self._active.add(key)
+            try:
+                self._run_batch(key, batch)
+            finally:
+                with self._cv:
+                    self._active.discard(key)
+                    self._cv.notify_all()
+
+    def _run_batch(self, key: str, batch: List[Request]) -> None:
+        now = time.monotonic()
+        live: List[Request] = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                # expired while queued: fail fast, never occupy the
+                # worker with a result nobody is waiting for
+                if self.metrics is not None:
+                    self.metrics.counter_inc("distel_deadline_expired_total")
+                req._fail(Deadline("deadline passed while queued"))
+            else:
+                live.append(req)
+        if not live:
+            return
+        kind = live[0].kind
+        if self.metrics is not None:
+            self.metrics.observe(
+                "distel_batch_size",
+                len(live),
+                {"kind": kind},
+                buckets=(1, 2, 4, 8, 16, 32, 64),
+            )
+            self.metrics.observe(
+                "distel_queue_wait_seconds",
+                now - min(r.enqueued for r in live),
+            )
+        try:
+            result = self._execute(key, kind, [r.payload for r in live])
+        except BaseException as e:  # noqa: BLE001 — relayed to waiters
+            for req in live:
+                req._fail(e)
+            return
+        for req in live:
+            req.batched = len(live)
+            req._resolve(result)
+
+    # --------------------------------------------------------- shutdown
+
+    def close(self, drain_s: float = 30.0) -> None:
+        """Stop admitting, fail everything still queued (callers get
+        :class:`ShuttingDown` → 503), and join the workers — bounded by
+        ``drain_s`` per worker so an in-flight saturation cannot wedge
+        shutdown."""
+        with self._cv:
+            if self._stopping:
+                return
+            self._stopping = True
+            for lane in self._lanes.values():
+                for req in lane:
+                    req._fail(ShuttingDown("server shutting down"))
+                    self._depth -= 1
+            self._lanes.clear()
+            self._order.clear()
+            self._cv.notify_all()
+        for t in self._workers:
+            t.join(timeout=drain_s)

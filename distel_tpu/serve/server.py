@@ -1,0 +1,435 @@
+"""The resident classification service (stdlib HTTP, no new deps).
+
+Endpoints::
+
+    POST /v1/ontologies                    load + classify; returns an id
+    POST /v1/ontologies/{id}/deltas        incremental update (fast path)
+    GET  /v1/ontologies/{id}/subsumers     ?class=<name> — named subsumers
+    GET  /v1/ontologies/{id}/taxonomy      parents/equivalents/unsat
+    GET  /healthz                          liveness + registry stats
+    GET  /metrics                          Prometheus text format
+
+Request bodies are JSON ``{"text": "<OWL functional syntax>"}``.  Write
+requests ride the scheduler (per-ontology serialization, delta batching,
+admission control); an over-capacity queue answers 429 + Retry-After and
+an over-deadline request answers 503 while the worker recovers on its
+own.  SIGTERM/SIGINT drain the scheduler and spill every resident
+closure through the checkpoint machinery before exit.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.runtime.instrumentation import PhaseAggregate, PhaseTimer
+from distel_tpu.serve.metrics import Metrics
+from distel_tpu.serve.registry import OntologyRegistry, UnknownOntology
+from distel_tpu.serve.scheduler import (
+    Deadline,
+    QueueFull,
+    RequestScheduler,
+    ShuttingDown,
+)
+
+#: request-body ceiling (64 MiB — a multiplied corpus is tens of MB; a
+#: larger body is almost certainly a mistake, and an unbounded read is a
+#: trivial way to wedge a resident server)
+MAX_BODY_BYTES = 64 << 20
+
+#: (method, pattern, handler name, canonical metrics label) — the label
+#: is fixed per route so client-chosen URLs can never mint new series
+_ROUTES = (
+    ("POST", re.compile(r"^/v1/ontologies/?$"), "load",
+     "/v1/ontologies"),
+    ("POST", re.compile(r"^/v1/ontologies/([^/]+)/deltas/?$"), "delta",
+     "/v1/ontologies/{id}/deltas"),
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/subsumers/?$"),
+     "subsumers", "/v1/ontologies/{id}/subsumers"),
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/taxonomy/?$"),
+     "taxonomy", "/v1/ontologies/{id}/taxonomy"),
+    ("GET", re.compile(r"^/healthz/?$"), "healthz", "/healthz"),
+    ("GET", re.compile(r"^/metrics/?$"), "metrics", "/metrics"),
+)
+
+
+def _endpoint_label(path: str) -> str:
+    """Bounded-cardinality metrics label for a request path: a route's
+    canonical label, or the single bucket "unmatched" — raw 404 paths
+    (scanners, typos) must never become label values on a server whose
+    job is staying up."""
+    for _meth, pattern, _name, label in _ROUTES:
+        if pattern.match(path):
+            return label
+    return "unmatched"
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str, headers=None):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.headers = dict(headers or {})
+
+
+class ServeApp:
+    """Registry + scheduler + metrics behind the HTTP handlers; owns no
+    sockets, so tests drive it in-process and ``make_server`` wraps it
+    for real serving."""
+
+    def __init__(
+        self,
+        config: Optional[ClassifierConfig] = None,
+        *,
+        workers: int = 2,
+        max_queue: int = 64,
+        max_batch: int = 8,
+        deadline_s: float = 300.0,
+        memory_budget_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        fast_path_min_concepts: Optional[int] = None,
+    ):
+        self.config = config or ClassifierConfig()
+        self.default_deadline_s = deadline_s
+        self.metrics = Metrics()
+        self.phases = PhaseAggregate()
+        self.registry = OntologyRegistry(
+            self.config,
+            memory_budget_bytes=memory_budget_bytes,
+            spill_dir=spill_dir,
+            metrics=self.metrics,
+            fast_path_min_concepts=fast_path_min_concepts,
+        )
+        self.scheduler = RequestScheduler(
+            self._execute,
+            workers=workers,
+            max_queue=max_queue,
+            max_batch=max_batch,
+            metrics=self.metrics,
+        )
+        self.started = time.time()
+        self._closed = False
+        #: oid → (increment, Taxonomy) — see :meth:`_tax`
+        self._tax_cache = {}
+        self.metrics.describe(
+            "distel_requests_total", "HTTP requests by endpoint and code"
+        )
+        self.metrics.describe(
+            "distel_deltas_fast_path_total",
+            "increments served by the compiled base program (no rebuild)",
+        )
+        self.metrics.describe(
+            "distel_saturation_rebuilds_total",
+            "increments that compiled a fresh engine",
+        )
+        self.metrics.gauge_fn(
+            "distel_queue_depth", self.scheduler.depth
+        )
+        self.metrics.gauge_fn(
+            "distel_inflight_requests", self.scheduler.active
+        )
+        self.metrics.gauge_fn(
+            "distel_resident_bytes", self.registry.resident_bytes
+        )
+
+    # -------------------------------------------------- scheduler plane
+
+    def _execute(self, key: str, kind: str, payloads: List):
+        """Single executor behind the scheduler workers.  ``payloads``
+        has length > 1 only for coalesced delta batches."""
+        timer = PhaseTimer()
+        try:
+            if kind == "load":
+                with timer.phase("load"):
+                    return self.registry.load(key, payloads[0])
+            if kind == "delta":
+                with timer.phase("delta"):
+                    return self.registry.delta(key, payloads)
+            if kind == "subsumers":
+                with timer.phase("query"):
+                    return self._subsumers(key, payloads[0])
+            if kind == "taxonomy":
+                with timer.phase("query"):
+                    return self._taxonomy(key)
+            raise ValueError(f"unknown request kind {kind!r}")
+        finally:
+            self.phases.absorb(timer)
+
+    def _tax(self, oid: str):
+        """The ontology's taxonomy, cached per increment.  Queries go
+        through the taxonomy projection rather than ``result.subsumers``
+        on purpose: the projection runs on device and moves only compact
+        arrays to the host (the dense ``result.s`` path would fetch and
+        densify the whole nc² closure — minutes over a remote-attach
+        tunnel at 64k — and leak internal gensym/aux names), and the
+        per-increment cache makes repeat queries O(dict).  Safe without
+        extra locking: requests for one ontology serialize on the
+        scheduler lane, so the cache entry for an oid is only touched by
+        one worker at a time."""
+        from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+        inc = self.registry.classifier(oid)
+        cached = self._tax_cache.get(oid)
+        if cached is not None and cached[0] == inc.increment:
+            return cached[1]
+        tax = extract_taxonomy(inc.last_result)
+        self._tax_cache[oid] = (inc.increment, tax)
+        return tax
+
+    def _subsumers(self, oid: str, cls: str) -> dict:
+        tax = self._tax(oid)
+        subs = tax.subsumers.get(cls)
+        if subs is None:
+            raise HTTPError(404, f"unknown class {cls!r} in {oid}")
+        return {"id": oid, "class": cls, "subsumers": subs}
+
+    def _taxonomy(self, oid: str) -> dict:
+        tax = self._tax(oid)
+        return {
+            "id": oid,
+            "parents": tax.parents,
+            "equivalents": tax.equivalents,
+            "unsatisfiable": tax.unsatisfiable,
+        }
+
+    # ------------------------------------------------------- HTTP plane
+
+    def dispatch(self, method: str, path: str, query: dict, body: bytes,
+                 deadline_s: Optional[float]):
+        """Route one request.  Returns ``(status, content_type, bytes)``;
+        raises :class:`HTTPError` for client/overload errors."""
+        for meth, pattern, name, _label in _ROUTES:
+            m = pattern.match(path)
+            if m is None:
+                continue
+            if meth != method:
+                raise HTTPError(405, f"{method} not allowed on {path}")
+            handler = getattr(self, f"_ep_{name}")
+            return handler(*m.groups(), query=query, body=body,
+                           deadline_s=deadline_s)
+        raise HTTPError(404, f"no route for {method} {path}")
+
+    def _schedule(self, key: str, kind: str, payload,
+                  deadline_s: Optional[float], batchable=False):
+        deadline = (
+            deadline_s if deadline_s is not None else self.default_deadline_s
+        )
+        try:
+            req = self.scheduler.submit(
+                key, kind, payload, deadline_s=deadline, batchable=batchable
+            )
+        except QueueFull as e:
+            raise HTTPError(429, str(e), {"Retry-After": "1"})
+        except ShuttingDown as e:
+            raise HTTPError(503, str(e))
+        try:
+            result = req.wait(deadline)
+        except Deadline as e:
+            raise HTTPError(503, str(e))
+        except ShuttingDown as e:
+            raise HTTPError(503, str(e))
+        except UnknownOntology as e:
+            raise HTTPError(404, f"unknown ontology {e.args[0]!r}")
+        except HTTPError:
+            raise
+        except Exception as e:
+            raise HTTPError(500, f"{type(e).__name__}: {e}")
+        return result
+
+    @staticmethod
+    def _json_text(body: bytes) -> str:
+        try:
+            doc = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise HTTPError(400, f"invalid JSON body: {e}")
+        text = doc.get("text") if isinstance(doc, dict) else None
+        if not isinstance(text, str) or not text.strip():
+            raise HTTPError(400, 'body must be {"text": "<axioms>"}')
+        return text
+
+    def _ep_load(self, *, query, body, deadline_s):
+        text = self._json_text(body)
+        oid = self.registry.new_id()
+        rec = self._schedule(oid, "load", text, deadline_s)
+        return 201, "application/json", _dumps(rec)
+
+    def _ep_delta(self, oid, *, query, body, deadline_s):
+        text = self._json_text(body)
+        rec = self._schedule(oid, "delta", text, deadline_s, batchable=True)
+        return 200, "application/json", _dumps(rec)
+
+    def _ep_subsumers(self, oid, *, query, body, deadline_s):
+        cls = query.get("class")
+        if not cls:
+            raise HTTPError(400, "subsumers needs ?class=<name>")
+        rec = self._schedule(oid, "subsumers", cls, deadline_s)
+        return 200, "application/json", _dumps(rec)
+
+    def _ep_taxonomy(self, oid, *, query, body, deadline_s):
+        rec = self._schedule(oid, "taxonomy", None, deadline_s)
+        return 200, "application/json", _dumps(rec)
+
+    def _ep_healthz(self, *, query, body, deadline_s):
+        doc = {
+            "status": "draining" if self._closed else "ok",
+            "uptime_s": round(time.time() - self.started, 1),
+            "queue_depth": self.scheduler.depth(),
+            **self.registry.stats(),
+        }
+        return 200, "application/json", _dumps(doc)
+
+    def _ep_metrics(self, *, query, body, deadline_s):
+        text = self.metrics.render(phase_aggregate=self.phases)
+        return 200, "text/plain; version=0.0.4", text.encode("utf-8")
+
+    # --------------------------------------------------------- shutdown
+
+    def close(self, final_spill: bool = True) -> List[str]:
+        """Drain the scheduler and (by default) spill every resident
+        closure — the graceful-shutdown path behind SIGTERM."""
+        if self._closed:
+            return []
+        self._closed = True
+        self.scheduler.close()
+        return self.registry.spill_all() if final_spill else []
+
+
+def _dumps(doc) -> bytes:
+    return (json.dumps(doc) + "\n").encode("utf-8")
+
+
+def _make_handler(app: ServeApp):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "distel-tpu-serve/1.0"
+
+        # quiet by default: per-request access logs go through metrics,
+        # not stderr (a resident server would drown the console)
+        def log_message(self, fmt, *args):
+            pass
+
+        def _respond(self, status, ctype, payload, headers=None):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _handle(self, method):
+            from urllib.parse import parse_qsl, urlsplit
+
+            t0 = time.monotonic()
+            split = urlsplit(self.path)
+            path = split.path
+            status = 500
+            try:
+                query = dict(parse_qsl(split.query))
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    raise HTTPError(400, "invalid Content-Length")
+                if length > MAX_BODY_BYTES:
+                    raise HTTPError(413, "request body too large")
+                if length < 0:
+                    # read(-1) would block until EOF, wedging the
+                    # handler thread on a client that never closes
+                    raise HTTPError(400, "invalid Content-Length")
+                body = self.rfile.read(length) if length else b""
+                deadline = self.headers.get("X-Distel-Deadline-S")
+                try:
+                    deadline_s = float(deadline) if deadline else None
+                except ValueError:
+                    raise HTTPError(400, "invalid X-Distel-Deadline-S")
+                status, ctype, payload = app.dispatch(
+                    method, path, query, body, deadline_s
+                )
+                self._respond(status, ctype, payload)
+            except HTTPError as e:
+                status = e.status
+                self._respond(
+                    e.status,
+                    "application/json",
+                    _dumps({"error": e.message}),
+                    e.headers,
+                )
+            except Exception as e:  # noqa: BLE001 — last-resort 500
+                status = 500
+                try:
+                    self._respond(
+                        500,
+                        "application/json",
+                        _dumps({"error": f"{type(e).__name__}: {e}"}),
+                    )
+                except Exception:
+                    pass
+            finally:
+                endpoint = _endpoint_label(path)
+                app.metrics.counter_inc(
+                    "distel_requests_total",
+                    {"endpoint": endpoint, "code": str(status)},
+                )
+                app.metrics.observe(
+                    "distel_request_seconds",
+                    time.monotonic() - t0,
+                    {"endpoint": endpoint},
+                )
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+    return Handler
+
+
+def make_server(
+    app: ServeApp, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind a threaded HTTP server over ``app`` (``port=0``: ephemeral —
+    read the bound port off ``server.server_address[1]``)."""
+    server = ThreadingHTTPServer((host, port), _make_handler(app))
+    server.daemon_threads = True
+    return server
+
+
+def serve_forever(app: ServeApp, host: str, port: int) -> List[str]:
+    """Blocking serve loop with graceful SIGTERM/SIGINT shutdown: stop
+    accepting, drain the scheduler, spill every resident closure via the
+    checkpoint machinery, and return the spill paths."""
+    server = make_server(app, host, port)
+    bound = server.server_address[1]
+    print(
+        json.dumps(
+            {
+                "serving": True,
+                "host": host,
+                "port": bound,
+                "spill_dir": app.registry.spill_dir,
+            }
+        ),
+        flush=True,
+    )
+
+    def _drain(signum, frame):
+        # shutdown() blocks until serve_forever returns — call it off
+        # the signal handler's thread
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    prev_term = signal.signal(signal.SIGTERM, _drain)
+    prev_int = signal.signal(signal.SIGINT, _drain)
+    try:
+        server.serve_forever()
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+        server.server_close()
+    return app.close()

@@ -227,6 +227,21 @@ def run_probe(args) -> None:
             if args.snapshot_every is not None
             else (5 if snap_path else 0)
         )
+        if snap_path and snap_every > 0:
+            # announce the disk cost at LAUNCH, not hours in: the
+            # uncompressed snapshot is the packed S/R wire state
+            # verbatim ((nc+nl) rows of wc uint32 words — ~941 MB at
+            # the 64k shape, multi-GB past 128k), and an operator who
+            # only discovers that when the first one lands may be out
+            # of disk mid-run
+            proj_gb = (engine.nc + engine.nl) * engine.wc * 4 / (1 << 30)
+            rec["snapshot_path"] = snap_path
+            rec["projected_snapshot_gb"] = round(proj_gb, 2)
+            print(json.dumps({
+                "snapshot_path": snap_path,
+                "snapshot_every_rounds": snap_every,
+                "projected_snapshot_gb": round(proj_gb, 2),
+            }), flush=True)
         snap_state = None
         base_derivs = base_iters = 0
         if args.resume_from:

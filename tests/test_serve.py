@@ -1,0 +1,419 @@
+"""Serve-plane tests: end-to-end load→query→delta→query over a live
+server thread (closure answers must match a direct IncrementalClassifier
+run), queue-full 429, deadline 503, eviction-then-reload-from-spill, the
+scheduler's batching/serialization contract, and graceful SIGTERM
+shutdown with a final snapshot spill."""
+
+import contextlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.serve.client import ServeClient, ServeError
+from distel_tpu.serve.metrics import Metrics
+from distel_tpu.serve.scheduler import Deadline, QueueFull, RequestScheduler
+from distel_tpu.serve.server import ServeApp, make_server
+
+BASE = """
+SubClassOf(A B)
+SubClassOf(B C)
+SubClassOf(C ObjectSomeValuesFrom(r D))
+SubClassOf(ObjectSomeValuesFrom(r D) E)
+SubClassOf(E F)
+"""
+
+# link-creating delta (new filler G ⇒ new link row) over an EXISTING
+# role: the reference's property-assertion traffic shape — must ride the
+# fast path's cross program, no rebuild
+DELTA = """
+SubClassOf(New0 A)
+SubClassOf(New0 ObjectSomeValuesFrom(r G))
+SubClassOf(G D)
+"""
+
+
+@contextlib.contextmanager
+def serving(**kw):
+    app = ServeApp(**kw)
+    server = make_server(app)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    port = server.server_address[1]
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=300)
+    try:
+        yield app, client
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close(final_spill=False)
+        thread.join(timeout=10)
+
+
+def _direct_subsumers(texts, cls, fast_min=0):
+    """The same texts through a plain IncrementalClassifier — the oracle
+    for what the server must answer (the server serves subsumers off the
+    taxonomy projection: named signature classes only)."""
+    from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = fast_min
+    for t in texts:
+        inc.add_text(t)
+    return extract_taxonomy(inc.last_result).subsumers[cls]
+
+
+def _metric(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+# ------------------------------------------------------------ end to end
+
+
+def test_serve_end_to_end_fast_path(tmp_path):
+    with serving(
+        fast_path_min_concepts=0, spill_dir=str(tmp_path)
+    ) as (app, client):
+        rec = client.load(BASE)
+        oid = rec["id"]
+        assert rec["path"] == "rebuild" and rec["concepts"] > 0
+
+        got = client.subsumers(oid, "A")
+        assert got["subsumers"] == _direct_subsumers([BASE], "A")
+
+        d = client.delta(oid, DELTA)
+        assert d["path"] == "fast"  # base program reused, no recompile
+        assert d["batched"] == 1
+
+        got = client.subsumers(oid, "New0")
+        want = _direct_subsumers([BASE, DELTA], "New0")
+        assert got["subsumers"] == want
+        assert {"A", "B", "C", "E", "F"} <= set(got["subsumers"])
+
+        tax = client.taxonomy(oid)
+        assert tax["parents"]["A"] == ["B"]
+
+        health = client.healthz()
+        assert health["status"] == "ok"
+        assert health["ontologies"] == 1 and health["resident"] == 1
+
+        m = client.metrics_text()
+        # the delta rode the fast path: fast-path counter incremented,
+        # rebuild counter stayed at the initial load's compile
+        assert _metric(m, "distel_deltas_fast_path_total") == 1
+        assert _metric(m, "distel_saturation_rebuilds_total") == 1
+        assert "distel_requests_total" in m
+        assert "distel_request_seconds_bucket" in m
+        assert "distel_request_phase_seconds_count" in m
+
+        # a second query compiles nothing: rebuild counter unchanged
+        client.subsumers(oid, "New0")
+        m2 = client.metrics_text()
+        assert _metric(m2, "distel_saturation_rebuilds_total") == 1
+
+        # unknown ontology / unknown class are clean 404s
+        with pytest.raises(ServeError) as ei:
+            client.subsumers("ont-9999", "A")
+        assert ei.value.status == 404
+        with pytest.raises(ServeError) as ei:
+            client.subsumers(oid, "NoSuchClass")
+        assert ei.value.status == 404
+
+
+# -------------------------------------------------- backpressure / 429
+
+
+def test_queue_full_yields_429(tmp_path):
+    with serving(
+        workers=1, max_queue=1, spill_dir=str(tmp_path)
+    ) as (app, client):
+        oid = client.load(BASE)["id"]
+
+        started = threading.Event()
+        release = threading.Event()
+        real_delta = app.registry.delta
+
+        def slow_delta(o, texts):
+            started.set()
+            release.wait(timeout=60)
+            return real_delta(o, texts)
+
+        app.registry.delta = slow_delta
+        results = {}
+
+        def post(name, **kw):
+            try:
+                results[name] = client.delta(oid, "SubClassOf(X%s A)" % name)
+            except ServeError as e:
+                results[name] = e
+
+        t1 = threading.Thread(target=post, args=("1",))
+        t1.start()
+        assert started.wait(timeout=60)  # d1 occupies the only worker
+        t2 = threading.Thread(target=post, args=("2",))
+        t2.start()
+        deadline = time.monotonic() + 60
+        while app.scheduler.depth() < 1:  # d2 queued (queue now full)
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # admission control: the bounded queue rejects rather than hangs
+        with pytest.raises(ServeError) as ei:
+            client.delta(oid, "SubClassOf(X3 A)")
+        assert ei.value.status == 429
+        assert ei.value.headers.get("Retry-After") == "1"
+
+        release.set()
+        t1.join(timeout=120)
+        t2.join(timeout=120)
+        assert results["1"]["id"] == oid
+        assert results["2"]["id"] == oid
+        m = client.metrics_text()
+        assert _metric(m, "distel_admission_rejected_total") >= 1
+
+
+# -------------------------------------------------------- deadlines/503
+
+
+def test_deadline_yields_503_and_worker_recovers(tmp_path):
+    with serving(
+        workers=1, max_queue=8, spill_dir=str(tmp_path)
+    ) as (app, client):
+        oid = client.load(BASE)["id"]
+
+        started = threading.Event()
+        release = threading.Event()
+        real_delta = app.registry.delta
+
+        def slow_delta(o, texts):
+            started.set()
+            release.wait(timeout=60)
+            return real_delta(o, texts)
+
+        app.registry.delta = slow_delta
+        # the only worker grinds on a long saturation; an over-deadline
+        # request answers 503 instead of wedging the caller
+        t1 = threading.Thread(
+            target=lambda: client.delta(oid, "SubClassOf(Y1 A)")
+        )
+        t1.start()
+        assert started.wait(timeout=60)  # Y1 occupies the only worker
+        with pytest.raises(ServeError) as ei:
+            client.delta(oid, "SubClassOf(Y2 A)", deadline_s=0.2)
+        assert ei.value.status == 503
+        release.set()
+        t1.join(timeout=120)
+
+        # worker recovered: a normal request succeeds afterwards
+        app.registry.delta = real_delta
+        rec = client.delta(oid, "SubClassOf(Y3 A)")
+        assert rec["id"] == oid
+        m = client.metrics_text()
+        assert _metric(m, "distel_deadline_expired_total") >= 1
+
+
+# -------------------------------------------- eviction / reload from spill
+
+
+def test_eviction_spills_and_reloads(tmp_path):
+    onto_b = "SubClassOf(P Q)\nSubClassOf(Q S)\n"
+    with serving(
+        memory_budget_bytes=1, spill_dir=str(tmp_path)
+    ) as (app, client):
+        oid_a = client.load(BASE)["id"]
+        oid_b = client.load(onto_b)["id"]
+        # loading B pushed A (LRU) over the 1-byte budget → spilled
+        spill = tmp_path / f"{oid_a}.snapshot.npz"
+        deadline = time.monotonic() + 60
+        while not spill.exists():
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        health = client.healthz()
+        assert health["spilled"] >= 1
+
+        # touching A restores it from the spill — same answers
+        got = client.subsumers(oid_a, "A")
+        assert got["subsumers"] == _direct_subsumers([BASE], "A")
+        m = client.metrics_text()
+        assert _metric(m, "distel_registry_evictions_total") >= 1
+        assert _metric(m, "distel_registry_restores_total") >= 1
+
+        # B still answers (restored or resident, transparently)
+        got_b = client.subsumers(oid_b, "P")
+        assert got_b["subsumers"] == _direct_subsumers([onto_b], "P")
+
+        # a delta lands on the restored classifier and stays consistent
+        d = client.delta(oid_a, DELTA)
+        assert d["id"] == oid_a
+        got = client.subsumers(oid_a, "New0")
+        assert got["subsumers"] == _direct_subsumers([BASE, DELTA], "New0")
+
+
+# ----------------------------------------------------- scheduler contract
+
+
+def test_scheduler_batches_and_serializes_per_key():
+    calls = []
+    release = threading.Event()
+
+    def execute(key, kind, payloads):
+        if kind == "block":
+            release.wait(timeout=60)
+        calls.append((key, kind, list(payloads)))
+        return {"key": key, "n": len(payloads)}
+
+    m = Metrics()
+    sched = RequestScheduler(
+        execute, workers=1, max_queue=16, max_batch=4, metrics=m
+    )
+    try:
+        blocker = sched.submit("A", "block", None, deadline_s=60)
+        # queued behind the blocker on another lane: contiguous
+        # batchable deltas coalesce into ONE executor call
+        reqs = [
+            sched.submit("B", "delta", f"d{i}", deadline_s=60,
+                         batchable=True)
+            for i in range(3)
+        ]
+        tail = sched.submit("B", "query", "q", deadline_s=60)
+        release.set()
+        assert blocker.wait(60)["key"] == "A"
+        for r in reqs:
+            out = r.wait(60)
+            assert out == {"key": "B", "n": 3}
+            assert r.batched == 3
+        assert tail.wait(60)["n"] == 1
+        kinds = [(k, kind, p) for k, kind, p in calls]
+        assert ("B", "delta", ["d0", "d1", "d2"]) in kinds
+        # the non-batchable query ran AFTER the batch (per-key FIFO)
+        assert kinds.index(("B", "query", ["q"])) > kinds.index(
+            ("B", "delta", ["d0", "d1", "d2"])
+        )
+        # queue-full admission is an exception, not a hang
+        ev = threading.Event()
+
+        def execute_never(*a):
+            ev.wait(60)
+
+        sched2 = RequestScheduler(execute_never, workers=1, max_queue=1)
+        try:
+            sched2.submit("X", "block", None, deadline_s=60)
+            deadline = time.monotonic() + 60
+            while sched2.depth() > 0:  # wait for the worker to pick it
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            sched2.submit("X", "q", None, deadline_s=60)  # fills queue
+            with pytest.raises(QueueFull):
+                sched2.submit("X", "q", None, deadline_s=60)
+        finally:
+            ev.set()
+            sched2.close()
+        # queued-past-deadline requests fail fast without executing
+        ev3 = threading.Event()
+
+        def execute_slow(key, kind, payloads):
+            ev3.wait(timeout=5)
+            return "done"
+
+        sched3 = RequestScheduler(execute_slow, workers=1, max_queue=8)
+        try:
+            first = sched3.submit("K", "x", None, deadline_s=60)
+            doomed = sched3.submit("K", "x", None, deadline_s=0.01)
+            time.sleep(0.05)
+            ev3.set()
+            assert first.wait(60) == "done"
+            with pytest.raises(Deadline):
+                doomed.wait(60)
+        finally:
+            sched3.close()
+    finally:
+        sched.close()
+
+
+def test_metrics_render_format():
+    m = Metrics()
+    m.describe("foo_total", "a counter")
+    m.counter_inc("foo_total", {"kind": "x"})
+    m.counter_inc("foo_total", {"kind": "x"})
+    m.gauge_set("bar", 3.5)
+    m.observe("lat_seconds", 0.03, buckets=(0.01, 0.1, 1.0))
+    m.observe("lat_seconds", 5.0, buckets=(0.01, 0.1, 1.0))
+    text = m.render()
+    assert '# HELP foo_total a counter' in text
+    assert 'foo_total{kind="x"} 2' in text
+    assert "bar 3.5" in text
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    # cumulative le buckets must stay monotone and ≤ the +Inf count
+    assert 'lat_seconds_bucket{le="1"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "lat_seconds_count 2" in text
+
+
+def test_phase_aggregate_absorbs_timers():
+    from distel_tpu.runtime.instrumentation import (
+        PhaseAggregate,
+        PhaseTimer,
+    )
+
+    agg = PhaseAggregate()
+    t = PhaseTimer()
+    with t.phase("load"):
+        pass
+    agg.absorb(t)
+    agg.observe("load", 0.5)
+    snap = agg.snapshot()
+    assert snap["load"]["count"] == 2
+    assert snap["load"]["max_s"] >= 0.5
+
+
+# ------------------------------------------------- graceful SIGTERM spill
+
+
+def test_cli_serve_sigterm_graceful_spill(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip TPU-tunnel registration
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distel_tpu.cli", "serve",
+            "--port", "0", "--spill-dir", str(tmp_path), "--workers", "1",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=repo,
+        env=env,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["serving"] is True
+        client = ServeClient(
+            f"http://127.0.0.1:{ready['port']}", timeout=240
+        )
+        oid = client.load(BASE)["id"]
+        assert client.subsumers(oid, "A")["subsumers"] == _direct_subsumers(
+            [BASE], "A"
+        )
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=240)
+        assert proc.returncode == 0, err
+        last = json.loads(out.strip().splitlines()[-1])
+        assert last["shutdown"] == "graceful"
+        # the resident closure was spilled through the checkpoint
+        # machinery on the way down
+        spill = os.path.join(str(tmp_path), f"{oid}.snapshot.npz")
+        assert last["spilled"] == [spill]
+        assert os.path.exists(spill)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=60)
