@@ -527,6 +527,21 @@ def _run_bench(load1_start: float) -> None:
                 "iterations": result.iterations,
                 "wall_s_warm": round(warm_s, 3),
                 "wall_s_cold": round(cold_s, 3),
+                # compile/execute split of the cold wall (ISSUE 2): the
+                # engine's AOT build telemetry separates program-build
+                # cost from saturation throughput in the perf record
+                "compile_s": round(
+                    engine.compile_stats.compile_s
+                    + engine.compile_stats.trace_lower_s,
+                    3,
+                ),
+                "persistent_cache_hits": (
+                    engine.compile_stats.persistent_cache_hits
+                ),
+                "program_cache_hit": (
+                    engine.compile_stats.program_cache_hit
+                ),
+                "bucket_signature": engine.bucket_signature,
                 "rtt_s": round(rtt_s, 3),
                 "baseline_cpu_dps": round(oracle_dps, 1),
                 "baseline_budget_s": 90.0,

@@ -38,8 +38,8 @@ def cmd_classify(args) -> int:
     from distel_tpu.config import enable_compile_cache
     from distel_tpu.runtime.classifier import ELClassifier
 
-    enable_compile_cache()
     cfg = _load_cfg(args)
+    enable_compile_cache(cfg.compile_cache_dir)
     if args.mesh:
         cfg.mesh_devices = args.mesh
     cfg.instrumentation = args.instrument
@@ -68,8 +68,8 @@ def cmd_stream(args) -> int:
     from distel_tpu.core.incremental import IncrementalClassifier
     from distel_tpu.runtime.checkpoint import Snapshotter
 
-    enable_compile_cache()
     cfg = _load_cfg(args)
+    enable_compile_cache(cfg.compile_cache_dir)
     inc = IncrementalClassifier(cfg)
     snap = (
         Snapshotter(args.snapshot_prefix, args.snapshot_interval)
@@ -329,6 +329,54 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_warmup(args) -> int:
+    """Warmup precompile: resolve each sample corpus to its shape
+    bucket and AOT-build that bucket's programs into the in-process
+    registry AND the persistent compile cache, so later classifies /
+    serve loads in the same bucket skip XLA entirely.  Prints one JSON
+    record per corpus (bucket signature + compile walls + cache hits)
+    and a summary line; distinct buckets compile concurrently."""
+    import os
+
+    from distel_tpu.config import enable_compile_cache
+    from distel_tpu.runtime.warmup import warmup_paths
+
+    cfg = _load_cfg(args)
+    # warmup exists to PERSIST programs — drop the 1 s persistence
+    # floor unless the operator pinned one, so tier-1-sized buckets
+    # land on disk too
+    os.environ.setdefault("DISTEL_CACHE_MIN_COMPILE_S", "0")
+    enable_compile_cache(cfg.compile_cache_dir)
+    t0 = time.time()
+    recs = warmup_paths(
+        args.ontologies,
+        cfg,
+        profile=args.profile,
+        max_iters=args.max_iters,
+        parallel=not args.serial,
+    )
+    for rec in recs:
+        print(json.dumps(rec), flush=True)
+    print(
+        json.dumps(
+            {
+                "warmed_buckets": len(
+                    {r["bucket_signature"] for r in recs}
+                ),
+                "corpora": len(recs),
+                "wall_s": round(time.time() - t0, 2),
+                "serial_compile_s": round(
+                    sum(
+                        r["compile_s"] + r["trace_lower_s"] for r in recs
+                    ),
+                    2,
+                ),
+            }
+        )
+    )
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Resident classification service: keeps one IncrementalClassifier
     per loaded ontology warm (compiled programs + device-resident
@@ -336,8 +384,8 @@ def cmd_serve(args) -> int:
     from distel_tpu.config import enable_compile_cache
     from distel_tpu.serve.server import ServeApp, serve_forever
 
-    enable_compile_cache()
     cfg = _load_cfg(args)
+    enable_compile_cache(cfg.compile_cache_dir)
     budget = (
         int(args.memory_budget_mb * (1 << 20))
         if args.memory_budget_mb is not None
@@ -352,6 +400,7 @@ def cmd_serve(args) -> int:
         memory_budget_bytes=budget,
         spill_dir=args.spill_dir,
         fast_path_min_concepts=args.fast_path_min_concepts,
+        warmup_paths=args.warmup,
     )
     spilled = serve_forever(app, args.host, args.port)
     print(
@@ -449,7 +498,33 @@ def main(argv=None) -> int:
     sv.add_argument("--fast-path-min-concepts", type=int, default=None,
                     help="override the delta fast path's base-size "
                          "cutoff (default ~32k; 0 forces it everywhere)")
+    sv.add_argument("--warmup", nargs="*", default=None, metavar="ONTOLOGY",
+                    help="sample corpora whose shape buckets a "
+                         "background thread precompiles at startup "
+                         "(loads in a warmed bucket skip XLA; watch "
+                         "distel_warmup_done on /metrics)")
     sv.set_defaults(fn=cmd_serve)
+
+    w = sub.add_parser(
+        "warmup",
+        help="precompile bucket programs from sample corpora "
+             "(in-process registry + persistent compile cache)",
+    )
+    w.add_argument("ontologies", nargs="+",
+                   help="one sample corpus per bucket to warm")
+    w.add_argument("--config", help="properties/config file")
+    w.add_argument("--profile", choices=("serve", "classify"),
+                   default="serve",
+                   help="which construction's programs to warm: the "
+                        "incremental/serve rebuild (default) or the "
+                        "one-shot classify engine")
+    w.add_argument("--max-iters", type=int, default=None,
+                   help="fixed-point budget the run program is "
+                        "compiled for (must match the consumer's "
+                        "max_iterations; default: config)")
+    w.add_argument("--serial", action="store_true",
+                   help="compile buckets one at a time (debugging)")
+    w.set_defaults(fn=cmd_warmup)
 
     b = sub.add_parser("bench", help="timing loop on one ontology")
     b.add_argument("ontology")

@@ -31,21 +31,30 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 
-def enable_compile_cache() -> None:
+def enable_compile_cache(cache_dir: Optional[str] = None) -> None:
     """Persistent XLA compilation cache (under ``~/.cache/distel_tpu``
-    unless the user set JAX_COMPILATION_CACHE_DIR) — repeat runs skip
-    the 10-100s jit compile of the saturation program.  Called by the
-    jax-using entry points (classify/stream/bench), never on import."""
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    unless the user set JAX_COMPILATION_CACHE_DIR or passed
+    ``cache_dir``) — repeat runs skip the 10-100s jit compile of the
+    saturation program, and with shape-bucketed programs
+    (``ClassifierConfig.shape_buckets``) DIFFERENT ontologies in one
+    bucket share the cached entry.  ``DISTEL_CACHE_MIN_COMPILE_S``
+    overrides the persistence floor (default 1.0 s; CI and the warmup
+    tests set it to 0 so tier-1-sized programs persist too).  Called by
+    the jax-using entry points (classify/stream/bench/serve/warmup),
+    never on import."""
+    if cache_dir is None and os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return
     try:
         import jax
 
-        cache = os.path.join(
+        cache = cache_dir or os.path.join(
             os.path.expanduser("~"), ".cache", "distel_tpu", "jax-cache"
         )
         jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        min_s = float(os.environ.get("DISTEL_CACHE_MIN_COMPILE_S", "1.0"))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_s
+        )
     except Exception:
         pass  # cache is an optimization, never a requirement
 
@@ -86,6 +95,21 @@ class ClassifierConfig:
     #: path), "packed" (x-major uint32 bitsets + Pallas kernels), or
     #: "auto" (rowpacked)
     engine: str = "auto"
+    #: shape-bucketed saturation programs (rowpacked engine only): every
+    #: compile-relevant static dimension quantizes onto a geometric
+    #: ladder and all ontology content rides in runtime arguments, so
+    #: same-bucket ontologies share one compiled program (in-process
+    #: registry + persistent cache) — the cold-start compile fix.
+    #: Exact shapes still apply to the incremental delta fast path's
+    #: pinned-layout engines and anywhere shape_buckets is off.
+    shape_buckets: bool = True
+    #: geometric ladder step for the corpus-size axes (concept rows,
+    #: link rows, rule-table rows) — padding waste per axis is bounded
+    #: by (bucket_ratio - 1)
+    bucket_ratio: float = 1.25
+    #: persistent XLA compile-cache directory override (None = the
+    #: enable_compile_cache default under ~/.cache/distel_tpu)
+    compile_cache_dir: Optional[str] = None
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -128,6 +152,12 @@ class ClassifierConfig:
             cfg.process_id = int(raw["process.id"])
         if "engine" in raw:
             cfg.engine = raw["engine"]
+        if "shape.buckets" in raw:
+            cfg.shape_buckets = raw["shape.buckets"].lower() == "true"
+        if "bucket.ratio" in raw:
+            cfg.bucket_ratio = float(raw["bucket.ratio"])
+        if "compile.cache.dir" in raw:
+            cfg.compile_cache_dir = raw["compile.cache.dir"]
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v

@@ -68,6 +68,47 @@ def _merge(into: NormalizedOntology, batch: NormalizedOntology) -> None:
     into.gensyms.update(batch.gensyms)
 
 
+def rebuild_engine(
+    config: ClassifierConfig,
+    idx,
+    mesh=None,
+    *,
+    capacity_pad: Optional[int] = None,
+    link_pad: Optional[int] = None,
+    window_headroom: Optional[int] = None,
+):
+    """THE engine construction of the incremental full-rebuild path —
+    capacity-padded concept/link headroom plus rebind window slots —
+    extracted so the warmup plane (``runtime/warmup.py``) builds
+    byte-identical programs: a warmup precompile only pays off if it
+    compiles exactly the program a later serve load will request, which
+    means the same construction path, not just the same corpus.  The
+    keyword overrides exist for callers (tests, a tuned deployment)
+    that changed a classifier instance's reservation attributes."""
+    import dataclasses as _dc
+
+    from distel_tpu.runtime.classifier import make_engine
+
+    if capacity_pad is None:
+        capacity_pad = IncrementalClassifier._CAPACITY_PAD
+    if link_pad is None:
+        link_pad = IncrementalClassifier._LINK_PAD
+    if window_headroom is None:
+        window_headroom = IncrementalClassifier._WINDOW_HEADROOM
+    cfg = _dc.replace(
+        config,
+        pad_multiple=max(config.pad_multiple, capacity_pad),
+    )
+    return make_engine(
+        cfg,
+        idx,
+        mesh=mesh,
+        min_concepts=idx.n_concepts + capacity_pad,
+        min_links_pad=idx.n_links + link_pad,
+        window_headroom=window_headroom,
+    )
+
+
 class IncrementalClassifier:
     """Owns the persistent Normalizer (shared gensym cache — the reference's
     NORMALIZE_CACHE role), the persistent Indexer (stable ids), and the
@@ -121,6 +162,10 @@ class IncrementalClassifier:
         #: by the last full rebuild + the index snapshot it was built at
         self._base_engine = None
         self._base_idx = None
+        #: program-build telemetry of the last increment (CompileStats
+        #: of the rebuild engine, or the summed delta programs on the
+        #: fast path) — the serve registry exports it to /metrics
+        self.last_compile = None
 
     def add_text(self, text: str) -> SaturationResult:
         return self.add_ontology(owl_loader.load(text))
@@ -163,6 +208,7 @@ class IncrementalClassifier:
 
     def add_ontology(self, onto) -> SaturationResult:
         idx, batch = self._ingest(onto)
+        self.last_compile = None
         result = self._delta_fast_path(idx)
         path = "fast" if result is not None else "rebuild"
         if result is None:
@@ -187,6 +233,11 @@ class IncrementalClassifier:
                 # here ("fast": base program reused; "rebuild": fresh
                 # compile)
                 "path": path,
+                **(
+                    self.last_compile.as_dict()
+                    if self.last_compile is not None
+                    else {}
+                ),
             }
         )
         self.last_result = result
@@ -224,7 +275,12 @@ class IncrementalClassifier:
         embedded closure; monotone EL+ saturation makes it a converged
         start, so the fixed point terminates after one quiet pass and
         the restored classifier is ready for further deltas (with a
-        fresh compiled base program for the fast path)."""
+        fresh compiled base program for the fast path).  Under
+        ``config.shape_buckets`` the rebuild engine is shape-BUCKETED:
+        the spilled closure embeds into the quantized padded layout and
+        the "fresh" base program is normally a program-registry or
+        persistent-cache hit, so a restore costs one quiet saturation
+        pass, not an XLA compile."""
         from distel_tpu.runtime.checkpoint import load_snapshot_state
 
         inc = cls(config)
@@ -253,6 +309,11 @@ class IncrementalClassifier:
                 "iterations": result.iterations,
                 "new_derivations": result.derivations,
                 "path": "restore",
+                **(
+                    inc.last_compile.as_dict()
+                    if inc.last_compile is not None
+                    else {}
+                ),
             }
         )
         inc.last_result = result
@@ -262,30 +323,23 @@ class IncrementalClassifier:
         """Compile a fresh engine for the whole accumulated corpus (with
         concept-id headroom so subsequent class-only deltas can reuse its
         program) and saturate from the previous closure."""
-        import dataclasses
-
         from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
-        from distel_tpu.runtime.classifier import make_engine
 
-        cfg = dataclasses.replace(
-            self.config,
-            pad_multiple=max(self.config.pad_multiple, self._CAPACITY_PAD),
-        )
         # the stale base engine's device constants and compiled programs
         # are useless once a rebuild starts — free them before the new
         # engine allocates
         self._base_engine = self._base_idx = None
-        engine = make_engine(
-            cfg,
+        # reservations for later deltas (see rebuild_engine): concept-
+        # lane headroom even when n_concepts lands exactly on a pad
+        # boundary, link rows for the cross-term path's new links, and
+        # live-window slots so a closure-growing role delta can rebind
+        # the compiled program's masks instead of rebuilding
+        engine = rebuild_engine(
+            self.config,
             idx,
             mesh=self._mesh,
-            # reservations for later deltas: concept-lane headroom even
-            # when n_concepts lands exactly on a pad boundary, link
-            # rows for the cross-term path's new links, and live-window
-            # slots so a closure-growing role delta can rebind the
-            # compiled program's masks instead of rebuilding
-            min_concepts=idx.n_concepts + self._CAPACITY_PAD,
-            min_links_pad=idx.n_links + self._LINK_PAD,
+            capacity_pad=self._CAPACITY_PAD,
+            link_pad=self._LINK_PAD,
             window_headroom=self._WINDOW_HEADROOM,
         )
         # hand the old closure over without keeping a reference in this
@@ -298,6 +352,7 @@ class IncrementalClassifier:
             self.config.max_iterations,
             initial=self._pop_state(),
         )
+        self.last_compile = getattr(engine, "compile_stats", None)
         if isinstance(engine, RowPackedSaturationEngine):
             self._base_engine, self._base_idx = engine, idx
         else:
@@ -545,6 +600,19 @@ class IncrementalClassifier:
             del r
             streak = streak + 1 if unproductive else 0
         final_total = _host_bit_total(fetch_global(lb(*box[0])))
+        # per-increment program cost: only the freshly compiled delta
+        # programs count (the base program's build was charged to the
+        # rebuild increment that produced it)
+        from distel_tpu.runtime.instrumentation import CompileStats
+
+        agg = CompileStats(
+            bucket_signature=getattr(base, "bucket_signature", ""),
+            program="delta-programs",
+        )
+        for eng in engines:
+            if eng is not base:
+                agg.merge(eng.compile_stats)
+        self.last_compile = agg
         return SaturationResult(
             packed_s=box[0][0],
             packed_r=box[0][1],

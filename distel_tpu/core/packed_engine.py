@@ -80,15 +80,32 @@ class PackedSaturationEngine:
         use_pallas: Optional[bool] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
         concept_axis: str = "c",
+        bucket: bool = False,
+        bucket_ratio: float = 1.25,
     ):
+        """``bucket``: SHAPE-ONLY bucketing — concept/link padding rides
+        the same geometric ladder as the row-packed engine, collapsing
+        the distinct state shapes nearby corpora compile for.  Unlike
+        the row-packed engine this one still traces its axiom tables as
+        constants, so cross-ontology program reuse needs identical
+        content; the ladder only helps the persistent cache across
+        repeat runs and keeps checkpoint layouts interchangeable with a
+        bucketed row-packed engine of the same corpus."""
+        from distel_tpu.core.program_cache import bucket_dim
+
         self.idx = idx
         self.unroll = max(int(unroll), 1)
         self.mesh = mesh
         self.concept_axis = concept_axis
         self.n_shards = int(mesh.shape[concept_axis]) if mesh is not None else 1
         pad_multiple = _pad_up(max(pad_multiple, 32), 32) * self.n_shards
-        self.nc = _pad_up(max(idx.n_concepts, 2), pad_multiple)
-        self.nl = max(_pad_up(idx.n_links, 32), 32)
+        base_c = max(idx.n_concepts, 2)
+        base_l = idx.n_links
+        if bucket:
+            base_c = bucket_dim(base_c + 1, bucket_ratio)
+            base_l = bucket_dim(base_l + 1, bucket_ratio)
+        self.nc = _pad_up(base_c, pad_multiple)
+        self.nl = max(_pad_up(base_l, 32), 32)
         self.wc = self.nc // 32
         self.wl = self.nl // 32
         self.rows_per_shard = self.nc // self.n_shards

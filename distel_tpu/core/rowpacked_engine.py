@@ -58,6 +58,8 @@ barrier + AND-vote (``controller/CommunicationHandler.java:49-84``).
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -76,12 +78,18 @@ from distel_tpu.core.engine import (
     observed_loop,
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
+from distel_tpu.core.program_cache import (
+    PROGRAMS,
+    bucket_dim,
+    signature_of,
+)
 from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
 from distel_tpu.ops.bitpack import (
     SegmentedRowOr,
     bit_lookup,
     bit_lookup_from,
 )
+from distel_tpu.runtime.instrumentation import CompileStats, compile_watch
 
 
 #: budget-floor chunk count past which the CR4/CR6 contractions compile
@@ -90,7 +98,7 @@ from distel_tpu.ops.bitpack import (
 _SCAN_CHUNK_THRESHOLD = 24
 
 
-def _factored_closure_tables(h, nf4_roles, chain_roles):
+def _factored_closure_tables(h, nf4_roles, chain_roles, n_pad=None):
     """``(h2, m4, m6)``: the factored-mask encoding — ``h`` extended
     with one all-zero SENTINEL role row (padded links carry the
     sentinel id, so their mask column is dead), then gathered per table
@@ -100,14 +108,20 @@ def _factored_closure_tables(h, nf4_roles, chain_roles):
     rebind_role_closure` rebuilds them under a grown closure — a drift
     between the two would bind wrong masks onto a compiled program.
     ``nf4_roles`` / ``chain_roles`` are the per-row role columns, or
-    None when the rule is off (empty table)."""
+    None when the rule is off (empty table).  ``n_pad``: quantized role
+    count of a shape-bucketed engine — the ρ axis widens to ``n_pad +
+    1`` (rows past the real roles stay all-zero, and the sentinel id
+    becomes ``n_pad``) so the mask-table SHAPES depend only on the
+    bucket rung, never on the exact role count."""
     n_roles = h.shape[0]
-    h2 = np.zeros((n_roles + 1, n_roles), np.int8)
+    if n_pad is None:
+        n_pad = n_roles
+    h2 = np.zeros((n_pad + 1, n_roles), np.int8)
     h2[:n_roles] = h
 
     def tab(roles):
         if roles is None:
-            return np.zeros((0, n_roles + 1), np.int8)
+            return np.zeros((0, n_pad + 1), np.int8)
         return np.ascontiguousarray(h2[:, roles].T)
 
     return h2, tab(nf4_roles), tab(chain_roles)
@@ -149,12 +163,16 @@ def _pad_to_slots(offs, c01, slots, p_off, p_c01):
 def _stack_span_masks(mask_tab, spans, rk):
     """[nch, rk, n_roles+1] per-chunk factored-mask slab: each kept
     span's rows tail-padded to ``rk`` with all-zero mask rows (pad rows
-    contribute nothing).  Shared by ``build_scan`` and
-    ``rebind_role_closure`` — see :func:`_fill_window_slabs`."""
-    return np.stack([
-        np.pad(mask_tab[a0:a1], ((0, rk - (a1 - a0)), (0, 0)))
-        for a0, a1 in spans
-    ])
+    contribute nothing).  Spans of a bucket-quantized row grid may
+    extend past the real table — the clamped slice pads all the way to
+    ``rk``, so quantization pad rows are all-zero mask rows too.
+    Shared by ``build_scan`` and ``rebind_role_closure`` — see
+    :func:`_fill_window_slabs`."""
+    out = []
+    for a0, a1 in spans:
+        seg = mask_tab[a0:a1]
+        out.append(np.pad(seg, ((0, rk - len(seg)), (0, 0))))
+    return np.stack(out)
 
 
 def _chunk_spans(n_rows, rk):
@@ -169,24 +187,37 @@ def _chunk_spans(n_rows, rk):
     return [(a0, min(a0 + rk, n_rows)) for a0 in range(0, n_rows, rk)]
 
 
-def _pos_maps(writers, n_rows):
+def _pos_maps(writers, n_rows, dead_rows=(), quantize=None):
     """Layered row → concat-position maps; position ``sentinel`` indexes
     a trailing always-False slot.  Rows written by k writers occupy k
     layers (k ≤ number of rules writing that state matrix).  Turns
     per-plan change vectors into a global changed-row mask with gathers
-    only — a scatter would serialize per index on TPU."""
+    only — a scatter would serialize per index on TPU.
+    ``dead_rows``: reserved dummy rows of a shape-bucketed engine (the
+    quantization pad segments' shared targets) — excluded from the maps
+    so (a) their always-no-op writes never surface in the frontier and
+    (b) the many pad segments aiming at one dead row don't inflate the
+    layer count.  ``quantize``: ladder function padding the LAYER COUNT
+    (extra layers are all-sentinel — harmless gathers) so the traced
+    layer structure collides across same-bucket ontologies."""
     offs = np.cumsum([0] + [len(t) for t in writers])
     sentinel = int(offs[-1])  # trailing always-False concat slot
     if not writers or n_rows == 0:
         return []
-    mult = np.zeros(n_rows, np.int64)
+    live = []
     for t in writers:
+        t = np.asarray(t)
+        keep = ~np.isin(t, dead_rows) if len(dead_rows) else slice(None)
+        live.append((t[keep], (offs[len(live)] + np.arange(len(t)))[keep]))
+    mult = np.zeros(n_rows, np.int64)
+    for t, _pos in live:
         mult[t] += 1
     n_layers = int(mult.max()) if len(mult) else 0
+    if quantize is not None:
+        n_layers = min(quantize(n_layers), len(writers))
     layers = [np.full(n_rows, sentinel, np.int64) for _ in range(n_layers)]
     level = np.zeros(n_rows, np.int64)
-    for w, t in enumerate(writers):
-        pos = offs[w] + np.arange(len(t))
+    for t, pos in live:
         lv = level[t]
         for li in range(n_layers):
             sel = lv == li
@@ -234,6 +265,8 @@ class RowPackedSaturationEngine:
         scan_chunks: Optional[bool] = None,
         scan_group_bytes: Optional[int] = None,
         window_headroom: int = 0,
+        bucket: bool = False,
+        bucket_ratio: float = 1.25,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -272,7 +305,26 @@ class RowPackedSaturationEngine:
         carry ``tval=False`` (the live multiplier zeroes the operand and
         the Pallas per-tile skip drops the MXU work); unrolled-mode
         slots point at the padded link-table tail, whose sentinel link
-        roles hit the factored mask's all-zero column."""
+        roles hit the factored mask's all-zero column.
+        ``bucket``: shape-bucketed program mode — every compile-relevant
+        static dimension quantizes onto the geometric ladder
+        (``core/program_cache.bucket_dim``, ×``bucket_ratio`` steps) and
+        every ontology-derived array (rule gather indices, seg-OR
+        targets, window slabs, frontier maps, the live-column mask)
+        rides in the runtime-argument pytree instead of being traced as
+        a constant.  The traced program is then a pure function of
+        ``self.bucket_signature``: two ontologies on the same rungs
+        share one compiled executable (the in-process ``PROGRAMS``
+        registry) and produce byte-identical HLO for the persistent
+        disk cache.  Quantization padding is closure-invisible: padded
+        rows/words are masked dead, pad segments of the quantized
+        seg-OR plans reduce an all-zero source into a reserved dead
+        state row (``nc-1`` / the pre-evening ``nl-1``), and CR4/CR6
+        gains padded table rows with all-zero factored-mask rows.
+        Bucket mode forces ``scan_chunks`` for CR4/CR6 (the unrolled
+        per-chunk formulation's structure is not canonicalized) and
+        plain row-budget chunk spans (role-aware splitting is
+        data-dependent)."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
@@ -283,20 +335,46 @@ class RowPackedSaturationEngine:
         self.mesh = mesh
         self.word_axis = word_axis
         self.n_shards = int(mesh.shape[word_axis]) if mesh is not None else 1
+        self._bucket = bool(bucket)
+        self._bucket_ratio = float(bucket_ratio)
+        #: corpus-axis ladder (floor 32) and small-structure ladder
+        #: (floor 1 — window slots, frontier layers) — see bucket_dim
+        self._q = lambda n: bucket_dim(n, self._bucket_ratio)
+        self._q1 = lambda n: bucket_dim(n, self._bucket_ratio, floor=1)
         pad_multiple = _pad_up(max(pad_multiple, 32), 32)
         # the packed word axis must divide evenly across shards
         # min_concepts: a cooperating caller (the incremental path) can
         # force concept-lane headroom beyond the corpus so later
         # class-only deltas fit the compiled program's padding even when
         # n_concepts lands exactly on a pad_multiple boundary
+        base_c = max(idx.n_concepts, min_concepts, 2)
+        if self._bucket:
+            # +1 before quantizing: the last concept row must be PAST
+            # the corpus — it is the reserved dead row the quantized
+            # plans' pad segments target (see _dead_c below)
+            base_c = self._q(max(idx.n_concepts + 1, min_concepts, 2))
         self.nc = _pad_up(
-            _pad_up(max(idx.n_concepts, min_concepts, 2), pad_multiple),
+            _pad_up(base_c, pad_multiple),
             32 * self.n_shards,
         )
         # min_links_pad: a cooperating engine (the incremental delta
         # fast path) can force this engine's link-row padding up to
         # another engine's, so their packed states interchange verbatim
-        self.nl = max(_pad_up(idx.n_links, 32), 32, _pad_up(min_links_pad, 32))
+        if self._bucket:
+            self.nl = _pad_up(
+                self._q(max(idx.n_links + 1, min_links_pad, 32)), 32
+            )
+        else:
+            self.nl = max(
+                _pad_up(idx.n_links, 32), 32, _pad_up(min_links_pad, 32)
+            )
+        # reserved dead rows of the bucketed plans' pad segments: the
+        # last concept row and the last PRE-EVENING link row (the link
+        # axis may still grow below when lc evens out the chunk grid;
+        # row nl-1 here stays a padding row either way).  Exact-mode
+        # engines never reference them.
+        self._dead_c = self.nc - 1
+        self._dead_l = self.nl - 1
         self.wc = self.nc // 32
         # ---- size-adaptive memory posture (measured on a 16 GB v5e with
         # the 96k-class many-role corpus, state = S_T 2.2 GB + R_T 1.6 GB):
@@ -372,20 +450,50 @@ class RowPackedSaturationEngine:
         empty2 = np.zeros((0, 2), np.int64)
         empty3 = np.zeros((0, 3), np.int64)
 
-        # --- per-rule static plans: sources permuted into seg-OR order
+        # --- per-rule static plans: sources permuted into seg-OR order.
+        # Bucket mode canonicalizes each plan's segment-length histogram
+        # (SegmentedRowOr.quantized): pad segments gather the reserved
+        # dead row and OR it into itself (CR1/CR2) or into the dead link
+        # row (CR3) — pure no-ops under OR, invisible to counts (the
+        # dead rows' live-column bits never change and CR3's one
+        # diagonal bit lands in a masked pad column).
+        def _rule_plan(tab, tgt_col, src_cols, pad_target):
+            plan = (
+                SegmentedRowOr.quantized(
+                    tab[:, tgt_col], self._qn, pad_target, len(tab)
+                )
+                if self._bucket
+                else SegmentedRowOr(tab[:, tgt_col])
+            )
+            srcs = [
+                np.append(tab[:, c], self._dead_c)[plan.order]
+                if self._bucket
+                else tab[plan.order, c]
+                for c in src_cols
+            ]
+            return (plan, *srcs)
+
+        #: segment/structure-count ladder: power-of-two rungs from 8 —
+        #: deliberately coarser than the corpus-axis ladder, because a
+        #: histogram has many entries and EVERY one must land on the
+        #: same rung for two programs to collide (pad segments are
+        #: near-free no-ops, so doubling a count costs little)
+        self._qn = lambda n: bucket_dim(n, 2.0, floor=8)
         nf1 = idx.nf1 if on("CR1") else empty2
-        self._p1 = SegmentedRowOr(nf1[:, 1])
-        self._src1 = nf1[self._p1.order, 0]
+        self._p1, self._src1 = _rule_plan(nf1, 1, (0,), self._dead_c)
         nf2 = idx.nf2 if on("CR2") else empty3
-        self._p2 = SegmentedRowOr(nf2[:, 2])
-        self._src2a = nf2[self._p2.order, 0]
-        self._src2b = nf2[self._p2.order, 1]
+        self._p2, self._src2a, self._src2b = _rule_plan(
+            nf2, 2, (0, 1), self._dead_c
+        )
         nf3 = idx.nf3 if on("CR3") else empty2
-        self._p3 = SegmentedRowOr(nf3[:, 1])
-        self._src3 = nf3[self._p3.order, 0]
+        self._p3, self._src3 = _rule_plan(nf3, 1, (0,), self._dead_l)
 
         # CR4/CR6 row tables (chunking, masks and link-table arrays are
-        # built later, once the final padded link-axis width is known)
+        # built later, once the final padded link-axis width is known).
+        # Bucket mode quantizes the ROW COUNT each rule's scanned chunk
+        # grid is laid out over; rows past the real table are handled by
+        # the span builders' tail clamping (all-zero mask rows, dead
+        # targets) and contribute nothing.
         self._has4 = bool(len(idx.nf4) and idx.n_links and on("CR4"))
         if self._has4:
             self._a4 = idx.nf4[:, 1]
@@ -394,6 +502,12 @@ class RowPackedSaturationEngine:
         )
         if self._has6:
             self._l26 = idx.chain_pairs[:, 1]
+        k4_rows = len(idx.nf4) if self._has4 else 0
+        k6_rows = len(idx.chain_pairs) if self._has6 else 0
+        if self._bucket:
+            k4_rows = self._q(k4_rows)
+            k6_rows = self._q(k6_rows)
+        self._k4_rows, self._k6_rows = k4_rows, k6_rows
 
         self._bottom = bool(
             idx.has_bottom_axioms and idx.n_links and on("CR5")
@@ -449,10 +563,7 @@ class RowPackedSaturationEngine:
         # below) engage only when a table's DENSE contraction volume is
         # super-TFLOP — below that, pruning saves sub-0.1s of chip time
         # while growing the traced program (≈ compile time)
-        rows_max = max(
-            len(idx.nf4) if self._has4 else 0,
-            len(idx.chain_pairs) if self._has6 else 0,
-        )
+        rows_max = max(self._k4_rows, self._k6_rows)
         big_tables = rows_max * self.nl * self.nc >= 5e11
 
         def role_chunks(tab_roles, tab_targets):
@@ -522,11 +633,16 @@ class RowPackedSaturationEngine:
         # threshold, per-chunk traced bodies dominate XLA compile time
         # (super-linear pass scaling — r3 measured 925 s at the 300k
         # shape) and the uniform-chunk lax.scan formulation takes over.
-        k4 = len(idx.nf4) if self._has4 else 0
-        k6 = len(idx.chain_pairs) if self._has6 else 0
+        k4 = self._k4_rows
+        k6 = self._k6_rows
         est_spans = -(-k4 // mm_rows) + -(-k6 // mm_rows)
         if scan_chunks is None:
             scan_chunks = est_spans > _SCAN_CHUNK_THRESHOLD
+        if self._bucket:
+            # the scanned formulation is the only canonicalized CR4/CR6
+            # structure (per-chunk unrolled bodies embed data-dependent
+            # plans) — always scan under bucketing
+            scan_chunks = True
         self._scan_mode = bool(scan_chunks) and (k4 + k6) > 0
         if self._scan_mode:
             self._cr4_chunks, self._cr6_chunks = [], []
@@ -577,6 +693,12 @@ class RowPackedSaturationEngine:
                 n_link_roles = int(
                     len(np.unique(idx.links[:, 0])) if idx.n_links else 1
                 )
+                if self._bucket:
+                    # the window length must be a pure function of the
+                    # bucket rung, not the exact distinct-role count
+                    n_link_roles = bucket_dim(
+                        n_link_roles, self._bucket_ratio, floor=1
+                    )
                 role_lc = _pad_up(
                     -(-self.nl // max(n_link_roles, 1)), 32
                 )
@@ -666,7 +788,15 @@ class RowPackedSaturationEngine:
         # They stay *arguments* to the jitted run (embedded constants
         # get serialized into every remote compile request).
         n_roles = h.shape[0]
-        self._link_roles = np.full(self.nl, n_roles, np.int32)  # sentinel
+        # bucket mode widens the factored-mask ρ axis to a quantized
+        # role count (extra rows all-zero) so the mask-table shapes are
+        # rung-determined; the sentinel id moves to the padded end
+        self._n_roles_pad = (
+            bucket_dim(n_roles, self._bucket_ratio, floor=8)
+            if self._bucket
+            else n_roles
+        )
+        self._link_roles = np.full(self.nl, self._n_roles_pad, np.int32)
         if idx.n_links:
             self._link_roles[: idx.n_links] = link_roles
 
@@ -677,6 +807,7 @@ class RowPackedSaturationEngine:
             h,
             idx.nf4[:, 0] if self._has4 else None,
             idx.chain_pairs[:, 0] if self._has6 else None,
+            n_pad=self._n_roles_pad,
         )
 
         # ---- static live-tile schedule: each CR4/CR6 row chunk
@@ -768,7 +899,8 @@ class RowPackedSaturationEngine:
             return kept, tiles, dropped_roles
 
         def build_scan(rk, lcn, tab_roles, rows_src, tab_targets,
-                       mask_tab, fd_idx, fd_pad, want_readers=True):
+                       mask_tab, fd_idx, fd_pad, want_readers=True,
+                       n_rows=None, pad_target=0):
             """Uniform padded chunk slabs for one rule's scanned
             contraction: the role-sorted table splits into spans of
             exactly ``rk`` rows (tail zero-padded — padded rows have
@@ -783,25 +915,49 @@ class RowPackedSaturationEngine:
             group — O(1) in chunk count.  ``fd_idx``/``fd_pad``: per-row
             indices into the rule's change-source vector (S-row mask for
             CR4, dirty_l for CR6; pad = the appended always-False slot),
-            folded to a per-chunk dirty scalar by one vectorized gather."""
-            spans = _chunk_spans(len(tab_roles), rk)
+            folded to a per-chunk dirty scalar by one vectorized gather.
+            ``n_rows``: bucket-quantized row-grid length (None = the
+            real table) — spans past the real table slice short/empty
+            and pad out exactly like per-span tail padding; bucket mode
+            KEEPS spans with no live windows (all-inert slots, so a
+            later ``rebind_role_closure`` can revive them) instead of
+            dropping them, because the chunk count must be a pure
+            function of the bucket rung.  ``pad_target``: row the pad
+            slots' seg-OR targets aim at (the bucketed dead row; 0 — a
+            no-op duplicate of the BOTTOM segment — for exact mode)."""
+            spans = _chunk_spans(
+                len(tab_roles) if n_rows is None else n_rows, rk
+            )
             rows_l, fdx_l = [], []
             offs_l, c01_l, tgt_l, reader_rows = [], [], [], []
             spans_kept, spans_dropped = [], []
             for a0, a1 in spans:
                 win = live_windows(tab_roles[a0:a1], lcn)
                 if win is None:
-                    spans_dropped.append((a0, a1))
-                    continue
+                    if not self._bucket:
+                        spans_dropped.append((a0, a1))
+                        continue
+                    win = (
+                        np.zeros(0, np.int32), np.zeros((0, 2), np.int32)
+                    )
                 spans_kept.append((a0, a1))
-                pad = rk - (a1 - a0)
-                rows_l.append(np.pad(rows_src[a0:a1], (0, pad)))
+                seg = rows_src[a0:a1]
+                rows_l.append(np.pad(seg, (0, rk - len(seg))))
+                seg = fd_idx[a0:a1]
                 fdx_l.append(
-                    np.pad(fd_idx[a0:a1], (0, pad), constant_values=fd_pad)
+                    np.pad(
+                        seg, (0, rk - len(seg)), constant_values=fd_pad
+                    )
                 )
                 offs_l.append(win[0])
                 c01_l.append(win[1])
-                tgt_l.append(np.pad(tab_targets[a0:a1], (0, pad)))
+                seg = tab_targets[a0:a1]
+                tgt_l.append(
+                    np.pad(
+                        seg, (0, rk - len(seg)),
+                        constant_values=pad_target,
+                    )
+                )
                 if want_readers:
                     reader_rows.append(rows_src[a0:a1])
             if not rows_l:
@@ -811,6 +967,8 @@ class RowPackedSaturationEngine:
             # reserve slots stay tval=False until rebind_role_closure
             # fills them for a grown closure
             T = int(n_windows.max()) + self._window_headroom
+            if self._bucket:
+                T = self._q1(T)  # window slab slots ride the ladder too
             offs_s, c01_s, tval_s = _fill_window_slabs(
                 offs_l, c01_l, nch, T
             )
@@ -827,21 +985,46 @@ class RowPackedSaturationEngine:
             wlw = self.wc // self.n_shards
             gch = max(int(group_bytes // max(rk * wlw * 4, 1)), 1)
             groups = []
+            group_args = []
             for g0 in range(0, nch, gch):
                 g1 = min(g0 + gch, nch)
                 tg = np.concatenate(tgt_l[g0:g1])
-                groups.append(
-                    (
-                        g0,
-                        g1,
-                        SegmentedRowOr(tg),
-                        # gate-reader rows: only the CR4 flags consult
-                        # them (CR6 groups re-dirty on ANY R change)
-                        np.unique(np.concatenate(reader_rows[g0:g1]))
-                        if want_readers
-                        else None,
+                if self._bucket:
+                    # canonical write plan: pad segments gather the
+                    # appended all-zero row of the group's (padded) scan
+                    # output — index (g1-g0)*rk — into the dead row
+                    plan = SegmentedRowOr.quantized(
+                        tg, self._qn, pad_target, (g1 - g0) * rk
                     )
+                else:
+                    plan = SegmentedRowOr(tg)
+                # gate-reader rows: only the CR4 flags consult
+                # them (CR6 groups re-dirty on ANY R change)
+                rows = (
+                    np.unique(np.concatenate(reader_rows[g0:g1]))
+                    if want_readers
+                    else None
                 )
+                if self._bucket:
+                    if rows is not None:
+                        rows = np.pad(
+                            rows,
+                            (0, self._qn(len(rows)) - len(rows)),
+                            constant_values=self._dead_c,
+                        )
+                    # runtime copies of the plan's data content — the
+                    # compiled program gathers/writes through THESE so
+                    # the jaxpr stays ontology-independent
+                    group_args.append(
+                        (
+                            jnp.asarray(plan.order.astype(np.int32)),
+                            jnp.asarray(plan.targets.astype(np.int32)),
+                            jnp.asarray(rows.astype(np.int32))
+                            if rows is not None
+                            else (),
+                        )
+                    )
+                groups.append((g0, g1, plan, rows))
             slabs = tuple(
                 jnp.asarray(x)
                 for x in (
@@ -864,9 +1047,12 @@ class RowPackedSaturationEngine:
                 # rebind_role_closure's structural record: which row
                 # spans the compiled program carries (and which it
                 # dropped as dead — a grown closure reviving one forces
-                # the rebuild path)
+                # the rebuild path; bucket mode drops nothing, so its
+                # rebind can revive any span within the T slots)
                 "spans_kept": spans_kept,
                 "spans_dropped": spans_dropped,
+                "group_args": tuple(group_args),
+                "pad_target": pad_target,
             }
 
         # the whole plan-table pytree (closure masks + live-tile
@@ -879,6 +1065,8 @@ class RowPackedSaturationEngine:
                 build_scan(
                     rk4, self.lc4, idx.nf4[:, 0], self._a4,
                     idx.nf4[:, 2], m4, self._a4, self.nc,
+                    n_rows=self._k4_rows if self._bucket else None,
+                    pad_target=self._dead_c if self._bucket else 0,
                 )
                 if self._has4
                 else None
@@ -889,6 +1077,8 @@ class RowPackedSaturationEngine:
                     idx.chain_pairs[:, 2], m6,
                     self._l26 // self.lc, self.n_lchunks,
                     want_readers=False,
+                    n_rows=self._k6_rows if self._bucket else None,
+                    pad_target=self._dead_l if self._bucket else 0,
                 )
                 if self._has6
                 else None
@@ -1025,13 +1215,86 @@ class RowPackedSaturationEngine:
             + ([np.asarray([BOTTOM_ID])] if self._bottom else [])
         )
         r_writers = ([self._p3.targets] if self._p3.k else []) + w6_targets
-        self._s_layers = _pos_maps(s_writers, self.nc)
-        self._r_layers = _pos_maps(r_writers, self.nl)
+        pm_kw = (
+            {"quantize": self._q1}
+            if self._bucket
+            else {}
+        )
+        self._s_layers = _pos_maps(
+            s_writers, self.nc,
+            dead_rows=(self._dead_c,) if self._bucket else (),
+            **pm_kw,
+        )
+        self._r_layers = _pos_maps(
+            r_writers, self.nl,
+            dead_rows=(self._dead_l,) if self._bucket else (),
+            **pm_kw,
+        )
         self._l2chunks6 = [
             np.unique(self._l26[raw] // self.lc)
             for raw, _, _ in self._cr6_chunks
         ]
         self._a4rows = [self._a4[raw] for raw, _, _ in self._cr4_chunks]
+
+        # ---- bucketed argument pytree + bucket signature.  Every
+        # ontology-derived array the step reads becomes a runtime
+        # argument here; the traced program is then a pure function of
+        # the structural metadata hashed into ``bucket_signature``, so
+        # same-bucket ontologies share one compiled executable (the
+        # process-global PROGRAMS registry) and identical persistent-
+        # cache HLO.
+        if self._bucket:
+
+            def i32(a):
+                return jnp.asarray(np.asarray(a, np.int32))
+
+            gate_rows = []
+            if self._gate is not None:
+                for kind, rows in self._gate["readers"]:
+                    if kind == "SR":
+                        gate_rows.append(
+                            i32(rows if rows is not None else
+                                np.zeros(0, np.int32))
+                        )
+            self._masks = {
+                "wmask": jnp.asarray(self._wmask),
+                "fills": i32(self._fillers),
+                "lroles": jnp.asarray(self._link_roles),
+                "src1": i32(self._src1),
+                "tgt1": i32(self._p1.targets),
+                "src2a": i32(self._src2a),
+                "src2b": i32(self._src2b),
+                "tgt2": i32(self._p2.targets),
+                "src3": i32(self._src3),
+                "tgt3": i32(self._p3.targets),
+                "s4": self._scan4["slabs"] if self._scan4 else (),
+                "s6": self._scan6["slabs"] if self._scan6 else (),
+                "g4": self._scan4["group_args"] if self._scan4 else (),
+                "g6": self._scan6["group_args"] if self._scan6 else (),
+                "sl": tuple(i32(pm) for pm in self._s_layers),
+                "rl": tuple(i32(pm) for pm in self._r_layers),
+                "gate_rows": tuple(gate_rows),
+            }
+        #: build-knob record folded into the signature (options that
+        #: steer tracing without leaving a distinct shape attribute)
+        self._sig_knobs = repr(
+            (
+                mm_opts, l_chunk, l_chunk_cr4, temp_budget_bytes,
+                scan_group_bytes, link_window, gate_chunks,
+            )
+        )
+        self.bucket_signature = self._compute_signature()
+        #: per-budget AOT executables (single-device; populated by
+        #: precompile()/saturate, shared across engines via PROGRAMS in
+        #: bucket mode)
+        self._aot_runs: dict = {}
+        self._aot_step = None
+        self._stats_lock = threading.Lock()
+        #: accumulated program-build telemetry for this engine
+        self.compile_stats = CompileStats(
+            bucket_signature=self.bucket_signature, program="total"
+        )
+        self.last_compile: Optional[CompileStats] = None
 
         if mesh is not None:
             P = jax.sharding.PartitionSpec
@@ -1257,7 +1520,8 @@ class RowPackedSaturationEngine:
         )
 
     def _bit_table(
-        self, p: jax.Array, rows: np.ndarray, axis_name: Optional[str]
+        self, p: jax.Array, rows: np.ndarray, axis_name: Optional[str],
+        cols=None,
     ) -> jax.Array:
         """``out[j, i] = bit(p[rows[i], column fillers[j]])`` as the
         matmul dtype, [nl, len(rows)] (transposed — callers fold the
@@ -1268,9 +1532,12 @@ class RowPackedSaturationEngine:
         packed analog of the reference's delta reads against the result
         node, ``base/Type2AxiomProcessorBase.java:101-116``).  The
         CR4/CR6 L-chunk loop uses ``bit_lookup_from`` directly; this
-        full-width variant serves CR5's ⊥-filler mask."""
+        full-width variant serves CR5's ⊥-filler mask.  ``cols``: a
+        bucketed engine passes its argument-carried filler table so the
+        column ids never trace as constants."""
         dt = self.matmul_dtype
-        cols = self._fillers
+        if cols is None:
+            cols = self._fillers
         if axis_name is None:
             return bit_lookup(p, rows, cols, dtype=dt)
         base = lax.axis_index(axis_name) * (self.wc // self.n_shards)
@@ -1331,6 +1598,221 @@ class RowPackedSaturationEngine:
             jnp.ones(self.nc, bool),
         )
 
+    # ------------------------------------------- programs & compilation
+
+    def _compute_signature(self) -> str:
+        """Signature of the traced program: every structural determinant
+        (shapes, plan structures, chunk/group/gate layout, backend) plus
+        the argument pytree's avals, hashed.  For a bucketed engine two
+        equal signatures imply the same jaxpr — the soundness condition
+        for sharing a compiled executable across ontologies.  Exact
+        engines get an ``exact…`` signature (their program additionally
+        embeds ontology constants, so it is only ever reused by the
+        same engine instance / the persistent cache's HLO keying)."""
+
+        def scan_sig(d):
+            if d is None:
+                return None
+            return (
+                d["rk"], d["lcn"], d["nch"], d["T"], d["pad_target"],
+                tuple(
+                    (g0, g1, plan.structure(),
+                     -1 if rows is None else len(np.asarray(rows)))
+                    for g0, g1, plan, rows in d["groups"]
+                ),
+                len(d["spans_kept"]), len(d["spans_dropped"]),
+            )
+
+        gate = None
+        if self._gate is not None:
+            gate = tuple(
+                (kind, -1 if rows is None else len(np.asarray(rows)))
+                for kind, rows in self._gate["readers"]
+            )
+        avals = jax.tree_util.tree_map(
+            lambda a: (tuple(np.shape(a)), str(jnp.asarray(a).dtype)),
+            self._masks,
+        )
+        parts = (
+            1,  # signature schema version
+            jax.default_backend(),
+            self.n_shards,
+            tuple(self.mesh.shape.items()) if self.mesh is not None else None,
+            self._bucket, self._bucket_ratio,
+            self.nc, self.nl, self.wc, self.unroll,
+            self.lc, self.lc4, self.n_lchunks, self._bw, self._n_sblocks,
+            self._serialize_chunks, self._use_pallas,
+            str(self.matmul_dtype),
+            tuple(sorted(self._rules)) if self._rules is not None else None,
+            self._bottom, self._n_roles_pad,
+            self._k4_rows, self._k6_rows, self._scan_mode,
+            getattr(self, "_scan_rk", None),
+            self._p1.structure(), self._p2.structure(),
+            self._p3.structure(),
+            scan_sig(self._scan4), scan_sig(self._scan6),
+            len(self._s_layers), len(self._r_layers),
+            self._window_headroom, gate,
+            self._dead_c, self._dead_l,
+            len(self._cr4_chunks), len(self._cr6_chunks),
+            self._sig_knobs,
+            avals,
+        )
+        prefix = ("b" if self._bucket else "exact") + f"{self.nc}x{self.nl}"
+        return signature_of(parts, prefix)
+
+    def _mask_avals(self):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a),
+                                           jnp.asarray(a).dtype),
+            self._masks,
+        )
+
+    def _note_compile(self, stats: CompileStats) -> None:
+        with self._stats_lock:
+            self.compile_stats.merge(stats)
+            self.last_compile = stats
+
+    def _run_aot(self, budget: int):
+        """Compiled fixed-point executable for ``budget`` iterations
+        (single-device path).  Bucket mode consults the process-global
+        ``PROGRAMS`` registry first: a same-signature engine built
+        earlier in this process hands its executable over outright (no
+        trace, no lower, no XLA), and on a registry miss the XLA
+        compile of the byte-identical HLO is normally a persistent
+        disk-cache hit.  Exact mode AOT-compiles per engine — the same
+        walls the old jit dispatch paid, but split into measured
+        ``compile_stats``."""
+        exe = self._aot_runs.get(budget)
+        if exe is not None:
+            return exe
+        stats = CompileStats(
+            bucket_signature=self.bucket_signature,
+            program=f"run[{budget}]",
+        )
+        sp_av = jax.ShapeDtypeStruct((self.nc, self.wc), jnp.uint32)
+        rp_av = jax.ShapeDtypeStruct((self.nl, self.wc), jnp.uint32)
+        mk_av = self._mask_avals()
+
+        def build():
+            t0 = time.perf_counter()
+            lowered = self._run_jit.lower(sp_av, rp_av, mk_av, budget)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            stats.trace_lower_s = t1 - t0
+            stats.compile_s = time.perf_counter() - t1
+            return compiled
+
+        with compile_watch(stats):
+            if self._bucket:
+                key = (self.bucket_signature, "run", budget)
+                exe, hit = PROGRAMS.get_or_build(key, build)
+                stats.program_cache_hit = hit
+            else:
+                exe = build()
+        self._aot_runs[budget] = exe
+        self._note_compile(stats)
+        return exe
+
+    def _step_aot(self):
+        """Compiled public-step executable (single-device) — same
+        registry/caching story as :meth:`_run_aot`."""
+        if self._aot_step is not None:
+            return self._aot_step
+        stats = CompileStats(
+            bucket_signature=self.bucket_signature, program="step"
+        )
+        sp_av = jax.ShapeDtypeStruct((self.nc, self.wc), jnp.uint32)
+        rp_av = jax.ShapeDtypeStruct((self.nl, self.wc), jnp.uint32)
+        mk_av = self._mask_avals()
+
+        def build():
+            t0 = time.perf_counter()
+            lowered = self._step_jit.lower(sp_av, rp_av, mk_av)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            stats.trace_lower_s = t1 - t0
+            stats.compile_s = time.perf_counter() - t1
+            return compiled
+
+        with compile_watch(stats):
+            if self._bucket:
+                key = (self.bucket_signature, "step")
+                exe, hit = PROGRAMS.get_or_build(key, build)
+                stats.program_cache_hit = hit
+            else:
+                exe = build()
+        self._aot_step = exe
+        self._note_compile(stats)
+        return exe
+
+    def precompile(
+        self,
+        max_iters: int = 10_000,
+        *,
+        programs: Tuple[str, ...] = ("run", "step"),
+        parallel: Optional[bool] = None,
+        max_workers: Optional[int] = None,
+    ) -> CompileStats:
+        """AOT-build this engine's program roster before any request
+        needs it — the warmup half of the cold-start overhaul.  The
+        roster is the per-program split of the superstep machinery this
+        engine will execute: the fixed-point ``run`` program (the XLA
+        heavyweight — the scanned per-rule group bodies live inside it)
+        and the public single-``step`` program; their ``.lower()``
+        ``.compile()`` pairs are driven concurrently on a thread pool
+        (XLA compiles release the GIL), overlapping pass time instead
+        of serializing it.  ``runtime/warmup.py`` layers cross-bucket
+        concurrency on top (one roster per configured bucket).
+
+        Mesh engines lower+compile through the sharded dispatch path —
+        that populates the persistent disk cache (the later dispatch
+        compile becomes a cache deserialization) without touching the
+        lru-cached jit wrappers.
+
+        Returns this engine's cumulative :class:`CompileStats` (equal
+        to this call's cost on a freshly built engine)."""
+        budget = _pad_up(max_iters, self.unroll)
+        if self.mesh is None:
+            roster = {
+                "run": lambda: self._run_aot(budget),
+                "step": self._step_aot,
+            }
+            tasks = [roster[name] for name in programs if name in roster]
+        else:
+
+            def mesh_run():
+                stats = CompileStats(
+                    bucket_signature=self.bucket_signature,
+                    program=f"run[{budget}]",
+                )
+                with compile_watch(stats):
+                    sp0, rp0 = self.initial_state()
+                    t0 = time.perf_counter()
+                    lowered = self._run_jit(budget).lower(
+                        sp0, rp0, self._masks
+                    )
+                    t1 = time.perf_counter()
+                    lowered.compile()
+                    stats.trace_lower_s = t1 - t0
+                    stats.compile_s = time.perf_counter() - t1
+                self._note_compile(stats)
+
+            tasks = [mesh_run]
+        if parallel is None:
+            parallel = len(tasks) > 1
+        if parallel and len(tasks) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=max_workers or len(tasks)
+            ) as pool:
+                for f in list(pool.map(lambda fn: fn(), tasks)):
+                    pass
+        else:
+            for fn in tasks:
+                fn()
+        return self.compile_stats
+
     def rebind_role_closure(self, new_closure) -> bool:
         """Re-bind this engine's COMPILED program to a grown role
         closure without recompiling — the masks-only partial rebuild for
@@ -1375,6 +1857,7 @@ class RowPackedSaturationEngine:
             h_new,
             idx.nf4[:, 0] if self._has4 else None,
             idx.chain_pairs[:, 0] if self._has6 else None,
+            n_pad=self._n_roles_pad,
         )
 
         def windows_fit(role_list, lcn, slots):
@@ -1449,12 +1932,22 @@ class RowPackedSaturationEngine:
             if self._scan6 is not None:
                 self._scan6["slabs"] = new_slabs["s6"]
                 self._scan6["n_windows"] = new_slabs["s6_nw"]
-            self._masks = (
-                self._masks[0],
-                self._masks[1],
-                self._scan4["slabs"] if self._scan4 else (),
-                self._scan6["slabs"] if self._scan6 else (),
-            )
+            if self._bucket:
+                # same compiled program, new argument content: only the
+                # slab leaves change — shapes (and so the signature and
+                # any registry-shared executable) are untouched
+                self._masks = dict(
+                    self._masks,
+                    s4=self._scan4["slabs"] if self._scan4 else (),
+                    s6=self._scan6["slabs"] if self._scan6 else (),
+                )
+            else:
+                self._masks = (
+                    self._masks[0],
+                    self._masks[1],
+                    self._scan4["slabs"] if self._scan4 else (),
+                    self._scan6["slabs"] if self._scan6 else (),
+                )
         else:
             new_tiles = {}
             for key, chunks, tiles, dropped, role_of, lcn in (
@@ -1569,18 +2062,26 @@ class RowPackedSaturationEngine:
             "mm_live_macs": live_macs,
         }
 
-    def _next_dirty(self, mask_s, any_r, axis_name):
+    def _next_dirty(self, mask_s, any_r, axis_name, mk=None):
         """End-of-step rule-gate flags from the shared changed-S-row
         mask and the any-R-change scalar; one tiny psum makes the flags
         globally uniform under sharding (the cond predicates must agree
-        across shards)."""
+        across shards).  Bucket mode reads the SR readers' row lists
+        from the argument pytree (``mk["gate_rows"]``, padded with the
+        dead row — which the pos-maps keep permanently clean)."""
         g = self._gate
         flags = []
+        si = 0
         for kind, rows in g["readers"]:
             if kind == "SR":
+                if self._bucket:
+                    rows_t = mk["gate_rows"][si]
+                    si += 1
+                else:
+                    rows_t = jnp.asarray(rows) if rows.size else None
                 d = any_r
-                if rows.size:
-                    d = d | jnp.any(mask_s[jnp.asarray(rows)])
+                if rows_t is not None and rows_t.shape[0]:
+                    d = d | jnp.any(mask_s[rows_t])
             elif kind == "RR":
                 d = any_r
             else:  # CR5
@@ -1591,24 +2092,35 @@ class RowPackedSaturationEngine:
             dirty = lax.psum(dirty.astype(jnp.int32), axis_name) > 0
         return dirty
 
-    def _next_frontier(self, s_vecs, r_vecs):
+    def _next_frontier(self, s_vecs, r_vecs, mk=None):
         """Fold this step's write change-vectors into
         ``(changed-S-row mask [nc], any_r, per-L-chunk R dirty flags)``
         via the layered permutation gathers of ``_pos_maps`` (a scatter
         would serialize per index on TPU).  The caller psums the parts
-        it carries across shards."""
+        it carries across shards.  Bucket mode gathers through the
+        argument-pytree layer maps (``mk["sl"]``/``mk["rl"]``)."""
         cs = jnp.concatenate(
             [v.astype(bool) for v in s_vecs] + [jnp.zeros(1, bool)]
         )
         cr = jnp.concatenate(
             [v.astype(bool) for v in r_vecs] + [jnp.zeros(1, bool)]
         )
+        s_layers = (
+            mk["sl"]
+            if self._bucket
+            else [jnp.asarray(pm) for pm in self._s_layers]
+        )
+        r_layers = (
+            mk["rl"]
+            if self._bucket
+            else [jnp.asarray(pm) for pm in self._r_layers]
+        )
         mask_s = jnp.zeros(self.nc, bool)
-        for pm in self._s_layers:
-            mask_s = mask_s | cs[jnp.asarray(pm)]
+        for pm in s_layers:
+            mask_s = mask_s | cs[pm]
         mask_r = jnp.zeros(self.nl, bool)
-        for pm in self._r_layers:
-            mask_r = mask_r | cr[jnp.asarray(pm)]
+        for pm in r_layers:
+            mask_r = mask_r | cr[pm]
         dirty_l = mask_r.reshape(self.n_lchunks, self.lc).any(axis=1)
         return mask_s, jnp.any(cr), dirty_l
 
@@ -1633,12 +2145,21 @@ class RowPackedSaturationEngine:
         soon as the last rule reads it — without this the fixed-point
         loop carries two full copies of S and OOMs ~2x earlier."""
         mk = self._masks if masks is None else masks
-        if self._scan_mode:
+        if self._bucket:
+            # bucketed engines carry EVERY ontology-derived array in the
+            # argument pytree — nothing below may close over self.* data
+            # content (structure only), or the compiled program would
+            # stop being shareable across same-bucket ontologies
+            fills, lroles = mk["fills"], mk["lroles"]
+            s4slabs, s6slabs = mk["s4"], mk["s6"]
+            m4 = m6 = t4 = t6 = None
+        elif self._scan_mode:
             fills, lroles, s4slabs, s6slabs = mk
             m4 = m6 = t4 = t6 = None
         else:
             m4, m6, fills, lroles, t4, t6 = mk
             s4slabs = s6slabs = None
+        bucket = self._bucket
         gating = self._gate is not None
         if dirty is None:  # stateless public step(): all-dirty
             dirty = self.initial_dirty()
@@ -1683,25 +2204,41 @@ class RowPackedSaturationEngine:
 
             def block_rules(sb, rb):
                 # named_scope: phase attribution for the step profiler
-                # (runtime/profiling.py reads scopes out of hlo_stats)
+                # (runtime/profiling.py reads scopes out of hlo_stats).
+                # Bucket mode swaps every gather/target constant for its
+                # argument-pytree copy (quantized-plan pad segments are
+                # dead-row self-loops — no-ops under OR).
                 cvs = []
                 if self._p1.k:  # CR1: a ⊑ b
                     with jax.named_scope("cr1"):
-                        red = self._p1.reduce(sb[jnp.asarray(self._src1)])
-                        sb, cv = self._p1.write(sb, red, track="rows")
+                        src = mk["src1"] if bucket else jnp.asarray(self._src1)
+                        red = self._p1.reduce(sb[src])
+                        sb, cv = self._p1.write(
+                            sb, red, track="rows",
+                            targets=mk["tgt1"] if bucket else None,
+                        )
                     cvs.append(cv)
                 if self._p2.k:  # CR2: a1 ⊓ a2 ⊑ b
                     with jax.named_scope("cr2"):
-                        red = self._p2.reduce(
-                            sb[jnp.asarray(self._src2a)]
-                            & sb[jnp.asarray(self._src2b)]
+                        if bucket:
+                            sa, sb2 = mk["src2a"], mk["src2b"]
+                        else:
+                            sa = jnp.asarray(self._src2a)
+                            sb2 = jnp.asarray(self._src2b)
+                        red = self._p2.reduce(sb[sa] & sb[sb2])
+                        sb, cv = self._p2.write(
+                            sb, red, track="rows",
+                            targets=mk["tgt2"] if bucket else None,
                         )
-                        sb, cv = self._p2.write(sb, red, track="rows")
                     cvs.append(cv)
                 if self._p3.k:  # CR3: a ⊑ ∃link — reads S, writes R
                     with jax.named_scope("cr3"):
-                        red = self._p3.reduce(sb[jnp.asarray(self._src3)])
-                        rb, cv = self._p3.write(rb, red, track="rows")
+                        src = mk["src3"] if bucket else jnp.asarray(self._src3)
+                        red = self._p3.reduce(sb[src])
+                        rb, cv = self._p3.write(
+                            rb, red, track="rows",
+                            targets=mk["tgt3"] if bucket else None,
+                        )
                     cvs.append(cv)
                 return sb, rb, cvs
 
@@ -1884,19 +2421,30 @@ class RowPackedSaturationEngine:
                     [s_changed, jnp.zeros(1, bool)]
                 )
                 mm4 = self._cr4_mm[0]
-                for g0, g1, gplan, _rows in self._scan4["groups"]:
+                for gi, (g0, g1, gplan, _rows) in enumerate(
+                    self._scan4["groups"]
+                ):
 
-                    def red4s(ops, g0=g0, g1=g1, gplan=gplan):
+                    def red4s(ops, g0=g0, g1=g1, gplan=gplan, gi=gi):
                         s, r = ops
                         out = scan_contract(
                             self._scan4, s4slabs, mm4, s, r,
                             s_changed_ext, g0, g1,
                         )
+                        if bucket:
+                            # quantized-plan pad segments gather the
+                            # appended all-zero row via the runtime
+                            # order argument
+                            out = jnp.pad(out, ((0, 1), (0, 0)))
+                            return gplan.reduce(out[mk["g4"][gi][0]])
                         return gplan.reduce(out[jnp.asarray(gplan.order)])
 
                     with jax.named_scope("cr4"):
                         red = gated_rows(gplan.n_targets, (sp, rp), red4s)
-                        sp, cv = gplan.write(sp, red, track="rows")
+                        sp, cv = gplan.write(
+                            sp, red, track="rows",
+                            targets=mk["g4"][gi][1] if bucket else None,
+                        )
                     s_vecs.append(cv)
                     ch |= jnp.any(cv)
                     if self._serialize_chunks:
@@ -1906,18 +2454,26 @@ class RowPackedSaturationEngine:
                     [dirty_l, jnp.zeros(1, bool)]
                 )
                 mm6 = self._cr6_mm[0]
-                for g0, g1, gplan, _rows in self._scan6["groups"]:
+                for gi, (g0, g1, gplan, _rows) in enumerate(
+                    self._scan6["groups"]
+                ):
 
-                    def red6s(r, g0=g0, g1=g1, gplan=gplan):
+                    def red6s(r, g0=g0, g1=g1, gplan=gplan, gi=gi):
                         out = scan_contract(
                             self._scan6, s6slabs, mm6, r, r,
                             dirty_l_ext, g0, g1,
                         )
+                        if bucket:
+                            out = jnp.pad(out, ((0, 1), (0, 0)))
+                            return gplan.reduce(out[mk["g6"][gi][0]])
                         return gplan.reduce(out[jnp.asarray(gplan.order)])
 
                     with jax.named_scope("cr6"):
                         red = gated_rows(gplan.n_targets, rp, red6s)
-                        rp, cv = gplan.write(rp, red, track="rows")
+                        rp, cv = gplan.write(
+                            rp, red, track="rows",
+                            targets=mk["g6"][gi][1] if bucket else None,
+                        )
                     r_vecs.append(cv)
                     ch |= jnp.any(cv)
                     if self._serialize_chunks:
@@ -1985,7 +2541,10 @@ class RowPackedSaturationEngine:
 
             def red5(ops):
                 s, r = ops
-                botf = self._bit_table(s, np.full(1, BOTTOM_ID), axis_name)
+                botf = self._bit_table(
+                    s, np.full(1, BOTTOM_ID), axis_name,
+                    cols=fills if bucket else None,
+                )
                 mask = botf[:, 0].astype(bool)              # [nl]
                 masked = jnp.where(
                     mask[:, None], r, jnp.asarray(0, jnp.uint32)
@@ -2004,10 +2563,10 @@ class RowPackedSaturationEngine:
             ch |= jnp.any(cv)
         with jax.named_scope("frontier"):
             mask_s, any_r, dirty_l_next = self._next_frontier(
-                s_vecs, r_vecs
+                s_vecs, r_vecs, mk
             )
             gate_next = (
-                self._next_dirty(mask_s, any_r, axis_name)
+                self._next_dirty(mask_s, any_r, axis_name, mk)
                 if gating
                 else gate_flags
             )
@@ -2023,7 +2582,7 @@ class RowPackedSaturationEngine:
         the shard-local word width, so the step runs inside the same
         shard_map structure as the fixed point."""
         if self.mesh is None:
-            return self._step_jit(sp, rp, self._masks)
+            return self._step_aot()(sp, rp, self._masks)
         if self._step_sharded is None:
             P = jax.sharding.PartitionSpec
             axis = self.word_axis
@@ -2036,11 +2595,16 @@ class RowPackedSaturationEngine:
     # -------------------------------------------------------- fixed point
 
     def _live_bits(
-        self, sp: jax.Array, rp: jax.Array, axis_name: Optional[str] = None
+        self, sp: jax.Array, rp: jax.Array, axis_name: Optional[str] = None,
+        wmask=None,
     ) -> jax.Array:
         """Per-row popcount over live x columns, [nc + nl] i32 (partial
-        per shard under sharding — the host total sums all partials)."""
-        wmask = jnp.asarray(self._wmask)
+        per shard under sharding — the host total sums all partials).
+        ``wmask``: the bucketed run program passes its argument-carried
+        live-column mask (the exact concept count varies within a
+        bucket); the eager per-engine jit keeps the constant."""
+        if wmask is None:
+            wmask = jnp.asarray(self._wmask)
         if axis_name is not None:
             wpl = self.wc // self.n_shards
             wmask = lax.dynamic_slice(
@@ -2086,7 +2650,10 @@ class RowPackedSaturationEngine:
                 self.initial_dirty(),
             ),
         )
-        return sp, rp, it, changed, self._live_bits(sp, rp, axis_name)
+        return sp, rp, it, changed, self._live_bits(
+            sp, rp, axis_name,
+            wmask=masks["wmask"] if self._bucket else None,
+        )
 
     def _sharded_run(self, max_iters: int):
         """Build (and cache per iteration budget) the jitted shard_map of
@@ -2121,7 +2688,11 @@ class RowPackedSaturationEngine:
             changed |= c
         if axis_name is not None:
             changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
-        return sp, rp, changed, self._live_bits(sp, rp, axis_name), dirty
+        bits = self._live_bits(
+            sp, rp, axis_name,
+            wmask=masks["wmask"] if self._bucket else None,
+        )
+        return sp, rp, changed, bits, dirty
 
     def saturate_observed(
         self,
@@ -2245,7 +2816,10 @@ class RowPackedSaturationEngine:
                     fetch_global(self._live_bits_jit(sp0, rp0))
                 )
         if self.mesh is None:
-            out = self._run_jit(sp0, rp0, self._masks, budget)
+            # AOT path: the compiled executable comes from the program
+            # registry (bucket mode) or this engine's per-budget cache —
+            # either way the build cost lands in compile_stats
+            out = self._run_aot(budget)(sp0, rp0, self._masks)
         else:
             out = self._run_jit(budget)(sp0, rp0, self._masks)
         return finish_device_run(

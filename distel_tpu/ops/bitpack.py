@@ -233,6 +233,82 @@ class SegmentedRowOr:
         blens = _next_pow2(counts)
         self._init_from_segments(seg_targets, counts, blens, first, order0)
 
+    @classmethod
+    def quantized(
+        cls, raw_targets: np.ndarray, quantize, pad_target: int,
+        pad_source: int,
+    ) -> "SegmentedRowOr":
+        """Canonical-structure plan for shape-bucketed engines: the
+        per-power-of-two segment-count histogram is quantized up through
+        ``quantize`` (the bucket ladder) by appending inert pad segments
+        — ``order`` slot ``pad_source`` (the caller's appended all-zero
+        source row) reduced into ``pad_target`` (the caller's reserved
+        dead state row), a no-op under OR.  Two same-bucket ontologies
+        then share ``self._buckets`` (the structure traced into the
+        program) exactly, while ``order``/``targets`` differ only in
+        CONTENT — which bucketed callers pass as runtime arguments
+        (:meth:`write`'s ``targets=``), keeping the jaxpr
+        ontology-independent."""
+        raw_targets = np.asarray(raw_targets, np.int64)
+        if raw_targets.size == 0:
+            return cls(raw_targets)
+        order0 = np.argsort(raw_targets, kind="stable")
+        sorted_t = raw_targets[order0]
+        seg_targets, first, counts = np.unique(
+            sorted_t, return_index=True, return_counts=True
+        )
+        blens = _next_pow2(counts)
+        # canonical level set: every power-of-two length from 1 up to
+        # min(top level, 64) is ALWAYS materialized (padded to the
+        # quantized count, at least quantize(1) segments), so a level
+        # that happens to be empty in one corpus and sparse in another
+        # still canonicalizes identically — total pad emission for the
+        # always-on range is O(8·127) rows, a constant.  Levels ABOVE
+        # 64 (hub targets with hundreds+ of members) are padded only
+        # when present: forcing them would cost 8×(level) inert rows
+        # per level per superstep — at a 64k-member hub that is ~1M pad
+        # emissions, dwarfing the rules' real work — while a big
+        # level's presence is next_pow2(hub size), doubly-log stable
+        # across similar corpora anyway.  Per-level pad cost is thus
+        # bounded by that level's own real emission (quantize at most
+        # doubles a present count).
+        present = dict(
+            zip(*map(lambda a: a.tolist(), np.unique(blens,
+                                                     return_counts=True)))
+        )
+        bc = max(int(blens.max()), 8)
+        level = 1
+        pad_blens = []
+        while level <= bc:
+            cnt = present.get(level, 0)
+            if cnt or level <= 64:
+                pad_blens.extend(
+                    [level] * (quantize(max(cnt, 1)) - cnt)
+                )
+            level *= 2
+        pad_blens = np.asarray(pad_blens, np.int64)
+        # order0 grows one trailing slot holding the pad-source token;
+        # pad segments (count=1, first=that slot) emit it blen times
+        order0 = np.append(order0, np.int64(pad_source))
+        plan = cls.__new__(cls)
+        plan._init_from_segments(
+            np.concatenate([seg_targets,
+                            np.full(len(pad_blens), pad_target, np.int64)]),
+            np.concatenate([counts, np.ones(len(pad_blens), np.int64)]),
+            np.concatenate([blens, pad_blens]),
+            np.concatenate(
+                [first, np.full(len(pad_blens), len(order0) - 1, np.int64)]
+            ),
+            order0,
+        )
+        return plan
+
+    def structure(self) -> tuple:
+        """The traced-program-relevant shape of this plan — what a
+        bucket signature must record so two engines sharing it can share
+        one compiled program."""
+        return (self.k, self.n_targets, tuple(self._buckets))
+
     def _init_from_segments(self, seg_targets, counts, blens, first, order0):
         """Build emission order + buckets from per-segment (target, member
         count, padded length, first-member offset into ``order0``).
@@ -295,20 +371,27 @@ class SegmentedRowOr:
             return (state, jnp.asarray(False)) if track else state
         return self.write(state, self.reduce(rows), track)
 
-    def write(self, state, reduced, track=False):
+    def write(self, state, reduced, track=False, targets=None):
         """The write half of :meth:`apply`: OR already-reduced per-target
         rows ``reduced`` [n_targets, W] into ``state``.  Split out so a
         gated caller can compute ``reduced`` under a ``lax.cond`` (zeros
         when the chunk is clean — OR is the identity on zeros) while the
         row write stays unconditional: only the chunk-bounded rows cross
         the cond boundary, never the multi-GB state (a state-valued cond
-        branch forces a full pass-through copy per skipped chunk)."""
+        branch forces a full pass-through copy per skipped chunk).
+        ``targets``: optional RUNTIME target-row array (shape
+        ``[n_targets]``) — bucketed engines pass their argument-carried
+        copy so the plan's own ``self.targets`` never becomes a traced
+        constant (the compiled program must stay ontology-independent).
+        Duplicate targets (a quantized plan's pad segments all aim at
+        the one reserved dead row) are safe: every duplicate writes the
+        identical ``old | 0`` value."""
         if self.k == 0:
             if track == "rows":
                 return state, jnp.zeros(0, bool)
             return (state, jnp.asarray(False)) if track else state
         state = jnp.asarray(state)
-        t = jnp.asarray(self.targets)
+        t = jnp.asarray(self.targets) if targets is None else targets
         old = state[t]
         merged = old | reduced
         out = state.at[t].set(merged)

@@ -31,6 +31,10 @@ class ClassificationResult:
     norm: Optional[NormalizedOntology]
     idx: IndexedOntology
     timer: PhaseTimer
+    #: program-build telemetry (rowpacked engines; None otherwise) —
+    #: bucket signature, trace/compile walls, program/persistent cache
+    #: hits.  See runtime/instrumentation.CompileStats.
+    compile_stats: Optional[object] = None
 
     def summary(self) -> dict:
         if self.norm is not None:
@@ -54,6 +58,11 @@ class ClassificationResult:
             "derivations": self.result.derivations,
             "unsatisfiable": len(self.taxonomy.unsatisfiable),
             "phases_ms": {k: round(v * 1000, 1) for k, v in self.timer.phases.items()},
+            **(
+                {"compile": self.compile_stats.as_dict()}
+                if self.compile_stats is not None
+                else {}
+            ),
         }
 
 
@@ -96,11 +105,26 @@ def make_engine(
     if choice == "rowpacked":
         from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
 
+        # shape-bucketed programs: the config-driven build paths (full
+        # classify, incremental full rebuild, serve loads) quantize
+        # their static shapes so same-bucket ontologies share one
+        # compiled program; callers that pin exact layouts (the delta
+        # fast path's base-interop engines) construct directly
+        rowpacked_kw.setdefault("bucket", config.shape_buckets)
+        rowpacked_kw.setdefault("bucket_ratio", config.bucket_ratio)
         return RowPackedSaturationEngine(idx, **kw, **rowpacked_kw)
     if choice == "packed":
         from distel_tpu.core.packed_engine import PackedSaturationEngine
 
-        return PackedSaturationEngine(idx, **kw)
+        # the packed engine's shape-only bucketing (its tables stay
+        # traced constants — see its docstring) still rides the config
+        # knob so padded layouts line up with bucketed rowpacked runs
+        return PackedSaturationEngine(
+            idx,
+            bucket=config.shape_buckets,
+            bucket_ratio=config.bucket_ratio,
+            **kw,
+        )
     return SaturationEngine(idx, **kw)
 
 
@@ -169,6 +193,12 @@ class ELClassifier:
             with timer.phase("index"):
                 idx = Indexer().index(norm)
         engine = self._make_engine(idx)
+        # AOT program build as its own phase: a warm bucket (program
+        # registry / persistent cache) shows up as compile ≈ 0 here,
+        # separating program cost from saturation throughput
+        if hasattr(engine, "precompile") and engine.mesh is None:
+            with timer.phase("compile"):
+                engine.precompile(cfg.max_iterations, programs=("run",))
         initial = None
         if resume_from is not None:
             with timer.phase("resume(align)"):
@@ -197,7 +227,10 @@ class ELClassifier:
                     )
         if cfg.instrumentation:
             print(timer.report(), flush=True)
-        return ClassificationResult(result, taxonomy, norm, idx, timer)
+        return ClassificationResult(
+            result, taxonomy, norm, idx, timer,
+            compile_stats=getattr(engine, "compile_stats", None),
+        )
 
     def classify_file(self, path: str, **kw) -> ClassificationResult:
         with open(path, "r", encoding="utf-8") as f:

@@ -91,6 +91,111 @@ class PhaseAggregate:
             }
 
 
+@dataclass
+class CompileStats:
+    """One program-build's cost decomposition — the record the cold-start
+    overhaul (shape-bucketed programs + warmup precompile) is steered
+    by.  ``trace_lower_s`` is the Python-side trace+StableHLO lowering,
+    ``compile_s`` the XLA pass wall; ``program_cache_hit`` means the
+    in-process :data:`~distel_tpu.core.program_cache.PROGRAMS` registry
+    served the executable outright (both walls ≈ 0); the persistent
+    counters are the *disk* cache's hit/miss events observed during this
+    build (an identical-HLO program from an earlier process makes
+    ``compile_s`` a cheap deserialization).  Threaded through
+    ``runtime/classifier.py`` → ``serve/registry.py`` → ``/metrics``."""
+
+    bucket_signature: str = ""
+    program: str = ""
+    trace_lower_s: float = 0.0
+    compile_s: float = 0.0
+    program_cache_hit: bool = False
+    persistent_cache_hits: int = 0
+    persistent_cache_misses: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "bucket_signature": self.bucket_signature,
+            "program": self.program,
+            "trace_lower_s": round(self.trace_lower_s, 4),
+            "compile_s": round(self.compile_s, 4),
+            "program_cache_hit": self.program_cache_hit,
+            "persistent_cache_hits": self.persistent_cache_hits,
+            "persistent_cache_misses": self.persistent_cache_misses,
+        }
+
+    def merge(self, other: "CompileStats") -> "CompileStats":
+        """Fold another program's build into this record (an engine
+        precompiles several programs; callers report one total)."""
+        self.trace_lower_s += other.trace_lower_s
+        self.compile_s += other.compile_s
+        self.program_cache_hit = self.program_cache_hit or other.program_cache_hit
+        self.persistent_cache_hits += other.persistent_cache_hits
+        self.persistent_cache_misses += other.persistent_cache_misses
+        return self
+
+
+class _PersistentCacheCounter:
+    """Process-global tally of jax's persistent-compilation-cache events
+    (``/jax/compilation_cache/cache_hits`` / ``cache_misses``).  jax's
+    monitoring listeners cannot be unregistered individually, so ONE
+    listener registers lazily and every :func:`compile_watch` window
+    reads before/after deltas.  Deltas are process-wide: concurrent
+    compiles on other threads land in whichever window is open — fine
+    for the counters' job (are we hitting the disk cache at all?), and
+    the aggregate totals are exact."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._registered = False
+        self.hits = 0
+        self.misses = 0
+
+    def _ensure(self) -> None:
+        with self._lock:
+            if self._registered:
+                return
+            try:
+                from jax._src import monitoring
+
+                monitoring.register_event_listener(self._on_event)
+                self._registered = True
+            except Exception:
+                # no monitoring API: counters stay 0, never an error
+                self._registered = True
+
+    def _on_event(self, name: str, **kw) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            with self._lock:
+                self.hits += 1
+        elif name == "/jax/compilation_cache/cache_misses":
+            with self._lock:
+                self.misses += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.hits, self.misses
+
+
+PERSISTENT_CACHE_EVENTS = _PersistentCacheCounter()
+
+
+@contextlib.contextmanager
+def compile_watch(stats: CompileStats):
+    """Attribute the persistent-cache events fired during this window to
+    ``stats`` (see :class:`_PersistentCacheCounter` for the concurrency
+    caveat)."""
+    PERSISTENT_CACHE_EVENTS._ensure()
+    h0, m0 = PERSISTENT_CACHE_EVENTS.snapshot()
+    try:
+        yield stats
+    finally:
+        h1, m1 = PERSISTENT_CACHE_EVENTS.snapshot()
+        stats.persistent_cache_hits += h1 - h0
+        stats.persistent_cache_misses += m1 - m0
+
+
 @contextlib.contextmanager
 def trace_to(log_dir: Optional[str]):
     """Optional XLA profiler capture around the saturation loop — the

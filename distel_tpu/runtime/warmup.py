@@ -1,0 +1,144 @@
+"""Warmup precompile: populate the program caches before traffic.
+
+The cold-start decomposition (ISSUE 2 / compile300k_512_cold_r5.log) is
+~95% XLA pass time, and with shape-bucketed programs every ontology in a
+bucket requests the SAME program — so a resident deployment can pay the
+compile before the first request exists: feed this module sample corpora
+(one per bucket you expect traffic in) and it AOT-builds each bucket's
+program roster.  Ontologies that later land in a warmed bucket classify
+with ``compile_s ≈ 0`` (in-process registry hit) — and even a restarted
+process only pays trace+lower, with XLA served from the persistent disk
+cache.
+
+Two construction profiles, matching the two program families the system
+actually runs:
+
+* ``"serve"`` (default) — the incremental full-rebuild construction
+  (``core/incremental.rebuild_engine``: capacity-padded headroom +
+  rebind window slots), i.e. the programs ``serve/`` loads, deltas and
+  restores request;
+* ``"classify"`` — the plain one-shot ``runtime/classifier.make_engine``
+  construction of ``cli classify``.
+
+Entry points: ``python -m distel_tpu.cli warmup`` and the serve plane's
+background precompile (``ServeApp(warmup_paths=...)``).  Multiple
+corpora compile concurrently on a thread pool — XLA compiles release the
+GIL, so distinct buckets' pass time genuinely overlaps (each engine's
+own roster is additionally compiled in parallel by
+``RowPackedSaturationEngine.precompile``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from distel_tpu.config import ClassifierConfig
+
+
+def _index_text(text: str, config: ClassifierConfig):
+    """Text → IndexedOntology through the same load planes classify
+    uses (native C++ for OFN when available, Python frontend else)."""
+    from distel_tpu.owl import loader as owl_loader
+
+    if (
+        config.use_native_loader
+        and owl_loader.detect_format(text) == "ofn"
+    ):
+        from distel_tpu.owl import native_loader
+
+        if native_loader.native_available():
+            return native_loader.load_indexed(text)
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.frontend.normalizer import normalize
+
+    return index_ontology(normalize(owl_loader.load(text)))
+
+
+def warmup_text(
+    text: str,
+    config: Optional[ClassifierConfig] = None,
+    *,
+    profile: str = "serve",
+    max_iters: Optional[int] = None,
+    mesh=None,
+) -> dict:
+    """Precompile the bucket programs one sample corpus resolves to.
+    Returns a record with the resolved ``bucket_signature`` and the
+    build's :class:`~distel_tpu.runtime.instrumentation.CompileStats`
+    fields (all ≈ 0 when the bucket was already warm)."""
+    config = config or ClassifierConfig()
+    t0 = time.monotonic()
+    idx = _index_text(text, config)
+    if profile == "serve":
+        from distel_tpu.core.incremental import rebuild_engine
+
+        engine = rebuild_engine(config, idx, mesh=mesh)
+    elif profile == "classify":
+        from distel_tpu.runtime.classifier import make_engine
+
+        engine = make_engine(config, idx, mesh=mesh)
+    else:
+        raise ValueError(
+            f"unknown warmup profile {profile!r}: 'serve' or 'classify'"
+        )
+    stats = engine.precompile(max_iters or config.max_iterations)
+    return {
+        "profile": profile,
+        "concepts": idx.n_concepts,
+        "links": idx.n_links,
+        "wall_s": round(time.monotonic() - t0, 3),
+        **stats.as_dict(),
+    }
+
+
+def warmup_texts(
+    texts: List[str],
+    config: Optional[ClassifierConfig] = None,
+    *,
+    profile: str = "serve",
+    max_iters: Optional[int] = None,
+    parallel: bool = True,
+    max_workers: Optional[int] = None,
+) -> List[dict]:
+    """Warm every bucket in ``texts`` (one sample corpus each),
+    concurrently by default.  Thread-level parallelism is safe: the
+    program registry serializes same-key builds, and distinct buckets'
+    XLA compiles overlap because compilation releases the GIL."""
+    config = config or ClassifierConfig()
+    if not parallel or len(texts) <= 1:
+        return [
+            warmup_text(
+                t, config, profile=profile, max_iters=max_iters
+            )
+            for t in texts
+        ]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+        max_workers=max_workers or min(len(texts), 4)
+    ) as pool:
+        return list(
+            pool.map(
+                lambda t: warmup_text(
+                    t, config, profile=profile, max_iters=max_iters
+                ),
+                texts,
+            )
+        )
+
+
+def warmup_paths(
+    paths: List[str],
+    config: Optional[ClassifierConfig] = None,
+    **kw,
+) -> List[dict]:
+    """File-path convenience over :func:`warmup_texts`."""
+    texts = []
+    for p in paths:
+        with open(p, "r", encoding="utf-8-sig") as f:
+            texts.append(f.read())
+    recs = warmup_texts(texts, config, **kw)
+    for p, r in zip(paths, recs):
+        r["file"] = p
+    return recs

@@ -238,6 +238,9 @@ class OntologyRegistry:
                 "distel_registry_restore_seconds",
                 time.monotonic() - t0,
             )
+        # a warm-bucket restore shows up here as a program-cache hit
+        # with compile ≈ 0 (the whole point of the warmup precompile)
+        self._note_compile(inc.last_compile)
         self._maybe_evict(keep=entry.oid)
         return inc
 
@@ -322,3 +325,24 @@ class OntologyRegistry:
             self._count("distel_deltas_fast_path_total")
         elif path == "rebuild":
             self._count("distel_saturation_rebuilds_total")
+        self._note_compile(inc.last_compile)
+
+    def _note_compile(self, st) -> None:
+        """Export one increment's program-build telemetry
+        (``CompileStats``): compile seconds, in-process program-registry
+        hit/miss, persistent disk-cache hits — the counters the warmup
+        precompile moves and the cold-start dashboards watch."""
+        if st is None or self.metrics is None:
+            return
+        build_s = st.compile_s + st.trace_lower_s
+        if build_s or st.program_cache_hit:
+            self.metrics.observe("distel_compile_seconds", build_s)
+        if st.program_cache_hit:
+            self._count("distel_program_cache_hits_total")
+        elif st.compile_s:
+            self._count("distel_program_cache_misses_total")
+        if st.persistent_cache_hits:
+            self.metrics.counter_inc(
+                "distel_persistent_cache_hits_total",
+                value=st.persistent_cache_hits,
+            )

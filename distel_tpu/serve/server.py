@@ -94,6 +94,7 @@ class ServeApp:
         memory_budget_bytes: Optional[int] = None,
         spill_dir: Optional[str] = None,
         fast_path_min_concepts: Optional[int] = None,
+        warmup_paths: Optional[List[str]] = None,
     ):
         self.config = config or ClassifierConfig()
         self.default_deadline_s = deadline_s
@@ -137,6 +138,62 @@ class ServeApp:
         self.metrics.gauge_fn(
             "distel_resident_bytes", self.registry.resident_bytes
         )
+        self.metrics.describe(
+            "distel_program_cache_hits_total",
+            "ontology loads served by an already-compiled bucket program",
+        )
+        self.metrics.describe(
+            "distel_program_cache_misses_total",
+            "ontology loads that had to compile their bucket program",
+        )
+        self.metrics.describe(
+            "distel_persistent_cache_hits_total",
+            "XLA compiles served from the persistent disk cache",
+        )
+        self.metrics.describe(
+            "distel_warmup_programs_total",
+            "bucket programs precompiled by the startup warmup",
+        )
+        # ---- background warmup precompile: populate the program
+        # registry / persistent cache for the configured buckets BEFORE
+        # traffic arrives; a failure only leaves the caches cold (the
+        # error counter says so), it never blocks serving
+        self._warmup_done = threading.Event()
+        if warmup_paths:
+            self.metrics.gauge_set("distel_warmup_done", 0)
+            threading.Thread(
+                target=self._run_warmup,
+                args=(list(warmup_paths),),
+                daemon=True,
+                name="distel-warmup",
+            ).start()
+        else:
+            self._warmup_done.set()
+
+    def _run_warmup(self, paths: List[str]) -> None:
+        try:
+            from distel_tpu.runtime import warmup as warmup_mod
+
+            recs = warmup_mod.warmup_paths(
+                paths, self.config, profile="serve"
+            )
+            for rec in recs:
+                self.metrics.counter_inc("distel_warmup_programs_total")
+                self.metrics.observe(
+                    "distel_compile_seconds",
+                    rec.get("compile_s", 0.0)
+                    + rec.get("trace_lower_s", 0.0),
+                )
+        except Exception:
+            self.metrics.counter_inc("distel_warmup_errors_total")
+        finally:
+            self.metrics.gauge_set("distel_warmup_done", 1)
+            self._warmup_done.set()
+
+    def warmup_wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the startup warmup finished (tests; ops probes
+        read the ``distel_warmup_done`` gauge instead)."""
+        return self._warmup_done.wait(timeout)
 
     # -------------------------------------------------- scheduler plane
 
@@ -280,6 +337,7 @@ class ServeApp:
             "status": "draining" if self._closed else "ok",
             "uptime_s": round(time.time() - self.started, 1),
             "queue_depth": self.scheduler.depth(),
+            "warmup_done": self._warmup_done.is_set(),
             **self.registry.stats(),
         }
         return 200, "application/json", _dumps(doc)

@@ -173,6 +173,19 @@ def run_probe(args) -> None:
     t0 = time.time()
     engine = RowPackedSaturationEngine(idx, mesh=mesh)
     rec["build_s"] = round(time.time() - t0, 1)
+    # resolved program identity + (later) the compile-vs-execute wall
+    # split: announced at LAUNCH so a killed multi-hour run still
+    # records which bucket/program it was paying for
+    rec["bucket_signature"] = engine.bucket_signature
+    print(
+        json.dumps(
+            {
+                "bucket_signature": engine.bucket_signature,
+                "build_s": rec["build_s"],
+            }
+        ),
+        flush=True,
+    )
 
     # ---- AOT: compile the full fixed-point program, read its memory
     # analysis (what round 2's probe recorded; kept for trend comparison)
@@ -190,6 +203,17 @@ def run_probe(args) -> None:
             )
         compiled = lowered.compile()
         rec["step_compile_s"] = round(time.time() - t0, 1)
+        # the compile half of the wall split, next to the snapshot-size
+        # launch log (the execute half lands in exec_wall_s below)
+        print(
+            json.dumps(
+                {
+                    "bucket_signature": engine.bucket_signature,
+                    "step_compile_s": rec["step_compile_s"],
+                }
+            ),
+            flush=True,
+        )
         try:
             ma = compiled.memory_analysis()
             n_sh = max(engine.n_shards, 1)

@@ -1,0 +1,267 @@
+"""Shape-bucketed programs (ISSUE 2): closure parity with exact-shape
+engines on tier-1 corpora, cross-ontology program reuse (in-process
+registry AND persistent disk cache), warmup precompile, and the
+quantized SegmentedRowOr canonicalization itself.
+
+The soundness claim under test: a bucketed engine's compiled program
+depends ONLY on its bucket signature — all ontology content rides in
+runtime arguments — so an executable compiled for one ontology is
+exactly the right program for any other ontology in the same bucket,
+and quantization padding (dead rows, pad segments, inert window slots)
+is closure-invisible."""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.program_cache import PROGRAMS, bucket_dim
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import (
+    snomed_shaped_ontology,
+    synthetic_ontology,
+)
+from distel_tpu.owl import parser
+from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+from test_packed_engine import BOTTOM_ONTO
+
+
+def _indexed(text):
+    norm = normalize(parser.parse(text))
+    return norm, index_ontology(norm)
+
+
+def _same_bucket_pair(shift_a=1, shift_b=3, n=240):
+    """Two ontologies with IDENTICAL table sizes and segment histograms
+    (so they land in one bucket by construction) but different axiom
+    WIRING — different gather indices, targets, closures.  A shared
+    compiled program is only sound if every ontology-derived array
+    really is a runtime argument; this pair is the regression tripwire."""
+
+    def onto(shift):
+        lines = []
+        for i in range(n):
+            lines.append(f"SubClassOf(C{i} C{(i + shift) % n})")
+        for i in range(0, n, 4):
+            lines.append(
+                f"SubClassOf(C{i} ObjectSomeValuesFrom(r D{i % 16}))"
+            )
+            lines.append(
+                f"SubClassOf(ObjectSomeValuesFrom(r D{(i + shift) % 16})"
+                f" E{i % 8})"
+            )
+        return "\n".join(lines)
+
+    return onto(shift_a), onto(shift_b)
+
+
+def _assert_parity(idx, bucketed_res, exact_res):
+    assert bucketed_res.derivations == exact_res.derivations
+    s_a = np.asarray(bucketed_res.packed_s)
+    s_b = np.asarray(exact_res.packed_s)
+    nw = min(s_a.shape[1], s_b.shape[1])
+    assert np.array_equal(
+        s_a[: idx.n_concepts, :nw], s_b[: idx.n_concepts, :nw]
+    )
+    r_a = np.asarray(bucketed_res.packed_r)
+    r_b = np.asarray(exact_res.packed_r)
+    assert np.array_equal(
+        r_a[: idx.n_links, :nw], r_b[: idx.n_links, :nw]
+    )
+
+
+# ------------------------------------------------ closure parity
+
+
+@pytest.mark.parametrize(
+    "text,diff_oracle",
+    [
+        # breadth parity (all rules, every golden fixture) lives in
+        # test_golden's rowpacked-bucketed runner; here: the ⊥-heavy
+        # fixture with an oracle diff, and the many-role SNOMED shape
+        # (the scan-regime corpus) against its exact engine
+        (BOTTOM_ONTO, True),
+        (snomed_shaped_ontology(n_classes=600), False),
+    ],
+    ids=["bottom", "snomed-shaped"],
+)
+def test_bucketed_closure_matches_exact(text, diff_oracle):
+    norm, idx = _indexed(text)
+    exact = RowPackedSaturationEngine(idx).saturate()
+    eng = RowPackedSaturationEngine(idx, bucket=True)
+    res = eng.saturate()
+    _assert_parity(idx, res, exact)
+    if diff_oracle:
+        report = diff_engine_vs_oracle(norm, res)
+        assert report.ok(), report.summary()
+
+
+def test_bucketed_resume_from_snapshot_state():
+    # embed path: a previous closure re-embeds into the bucketed layout
+    # and the resumed fixed point derives NOTHING new (it was converged)
+    norm, idx = _indexed(BOTTOM_ONTO)
+    first = RowPackedSaturationEngine(idx, bucket=True).saturate()
+    eng = RowPackedSaturationEngine(idx, bucket=True)
+    resumed = eng.saturate(
+        initial=(first.packed_s, first.packed_r)
+    )
+    assert resumed.derivations == 0
+    s_a, s_b = np.asarray(resumed.packed_s), np.asarray(first.packed_s)
+    nw = min(s_a.shape[1], s_b.shape[1])
+    assert np.array_equal(
+        s_a[: idx.n_concepts, :nw], s_b[: idx.n_concepts, :nw]
+    )
+
+
+# -------------------------------------- cross-ontology program reuse
+
+
+def test_same_bucket_different_ontology_shares_program():
+    text_a, text_b = _same_bucket_pair()
+    _, idx_a = _indexed(text_a)
+    _, idx_b = _indexed(text_b)
+    eng_a = RowPackedSaturationEngine(idx_a, bucket=True)
+    eng_b = RowPackedSaturationEngine(idx_b, bucket=True)
+    assert eng_a.bucket_signature == eng_b.bucket_signature
+    res_a = eng_a.saturate()
+    assert not eng_a.compile_stats.program_cache_hit or (
+        PROGRAMS.stats()["programs"] > 0
+    )
+    cold = eng_a.compile_stats.compile_s + eng_a.compile_stats.trace_lower_s
+    res_b = eng_b.saturate()
+    # the acceptance demo: the second, DIFFERENT ontology skips
+    # compilation outright (program-registry hit), ≥10x under the cold
+    # program build
+    assert eng_b.compile_stats.program_cache_hit
+    warm = eng_b.compile_stats.compile_s + eng_b.compile_stats.trace_lower_s
+    assert cold > 0.0 and warm * 10 <= cold
+    # ...and the SHARED program computes each ontology's own closure
+    for idx, res in ((idx_a, res_a), (idx_b, res_b)):
+        exact = RowPackedSaturationEngine(idx).saturate()
+        _assert_parity(idx, res, exact)
+
+
+def test_persistent_cache_hit_across_program_registry_clear(tmp_path):
+    """Disk-cache half of the story: byte-identical HLO ⇒ the XLA
+    compile of a fresh (registry-cold) engine deserializes from the
+    persistent cache — the cross-PROCESS warm path, exercised in one
+    process by clearing every in-memory cache."""
+    import jax
+
+    from distel_tpu.runtime.instrumentation import PERSISTENT_CACHE_EVENTS
+
+    text_a, text_b = _same_bucket_pair()
+    _, idx_a = _indexed(text_a)
+    _, idx_b = _indexed(text_b)
+    from jax._src import compilation_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # the cache singleton latches its directory on first use — reset so
+    # the tmp_path actually takes effect mid-process
+    compilation_cache.reset_cache()
+    try:
+        # an earlier test may have registered this bucket's program
+        # in-process — drop it so precompile really writes to disk.
+        # (AOT ``lowered.compile()`` never consults jax's jit dispatch
+        # cache, so clearing the registry alone makes the disk the only
+        # warm layer — no suite-slowing ``jax.clear_caches()`` needed.)
+        PROGRAMS.clear()
+        eng_a = RowPackedSaturationEngine(idx_a, bucket=True)
+        eng_a.precompile(programs=("run",))
+        PROGRAMS.clear()
+        eng_b = RowPackedSaturationEngine(idx_b, bucket=True)
+        assert eng_b.bucket_signature == eng_a.bucket_signature
+        res_b = eng_b.saturate()
+        st = eng_b.compile_stats
+        assert not st.program_cache_hit
+        assert st.persistent_cache_hits > 0
+        exact = RowPackedSaturationEngine(idx_b).saturate()
+        _assert_parity(idx_b, res_b, exact)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min
+        )
+        compilation_cache.reset_cache()
+
+
+# (warmup → serve-bucket reuse is covered end-to-end by test_serve.py::
+# test_warmup_precompile_makes_same_bucket_load_compile_free, which
+# drives runtime/warmup.py through the ServeApp background thread)
+
+
+# ------------------------------------------------- plan canonicalization
+
+
+def test_quantized_segor_matches_plain_reduce():
+    from distel_tpu.ops.bitpack import SegmentedRowOr
+
+    rng = np.random.default_rng(3)
+    qn = lambda n: bucket_dim(n, 2.0, floor=8)  # noqa: E731
+    for trial in range(20):
+        n_state = int(rng.integers(4, 30))
+        k = int(rng.integers(1, 80))
+        targets = rng.integers(0, n_state - 1, size=k)
+        plan = SegmentedRowOr.quantized(targets, qn, n_state - 1, k)
+        rows = rng.integers(0, 2**32, size=(k, 3), dtype=np.uint32)
+        state = rng.integers(0, 2**32, size=(n_state, 3), dtype=np.uint32)
+        # engine convention: pad slot k gathers the dead row itself —
+        # a self-loop, the identity under OR
+        srcs = np.vstack([rows, state[n_state - 1 : n_state]])
+        got = np.asarray(plan.write(state, plan.reduce(srcs[plan.order])))
+        want = state.copy()
+        for t, row in zip(targets, rows):
+            want[t] |= row
+        assert (got == want).all(), trial
+
+
+def test_quantized_segor_structure_collides_across_wirings():
+    from distel_tpu.ops.bitpack import SegmentedRowOr
+
+    qn = lambda n: bucket_dim(n, 2.0, floor=8)  # noqa: E731
+    a = np.repeat(np.arange(40), 2)  # every target twice
+    b = np.repeat(np.arange(100, 140)[::-1], 2)  # different rows, same shape
+    pa = SegmentedRowOr.quantized(a, qn, 999, len(a))
+    pb = SegmentedRowOr.quantized(b, qn, 999, len(b))
+    assert pa.structure() == pb.structure()
+
+
+def test_bucket_dim_ladder_is_monotone_and_fixed():
+    prev = 0
+    for n in range(0, 5000, 7):
+        v = bucket_dim(n)
+        assert v >= n
+        assert v >= prev or n == 0
+        prev = max(prev, v)
+    assert bucket_dim(0) == 0
+    assert bucket_dim(1) == 32
+    assert bucket_dim(33, floor=1) < bucket_dim(33)  # finer floor family
+
+
+def test_bucketed_rebind_role_closure_matches_fresh():
+    """The masks-only partial rebuild must survive bucketing: the grown
+    closure reaches the SHARED compiled program purely through the
+    argument pytree (rebuilt mask slabs + window tables), so the rebind
+    swaps argument content without perturbing the bucket signature."""
+    from test_rowpacked_engine import _REBIND_BASE
+
+    _, idx_old = _indexed(_REBIND_BASE)
+    _, idx_new = _indexed(_REBIND_BASE + "SubObjectPropertyOf(r s)\n")
+    kw = dict(bucket=True, window_headroom=2)
+    fresh = RowPackedSaturationEngine(idx_new, **kw).saturate()
+    eng = RowPackedSaturationEngine(idx_old, **kw)
+    before = eng.saturate()
+    sig0 = eng.bucket_signature
+    assert eng.rebind_role_closure(idx_new.role_closure)
+    assert eng.bucket_signature == sig0
+    resumed = eng.saturate(initial=(before.packed_s, before.packed_r))
+    assert np.array_equal(
+        np.asarray(resumed.packed_s), np.asarray(fresh.packed_s)
+    )
+    assert np.array_equal(
+        np.asarray(resumed.packed_r), np.asarray(fresh.packed_r)
+    )

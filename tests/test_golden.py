@@ -123,6 +123,13 @@ RUNNERS = [
     _EngineRunner(SaturationEngine, "dense"),
     _EngineRunner(PackedSaturationEngine, "packed"),
     _EngineRunner(RowPackedSaturationEngine, "rowpacked"),
+    # shape-bucketed programs (ISSUE 2): quantization padding and the
+    # argument-carried plan tables must be closure-invisible on every
+    # golden fixture — and the tiny fixtures collapse into a few shared
+    # buckets, so this runner also exercises cross-ontology program
+    # reuse against external ground truth
+    _EngineRunner(RowPackedSaturationEngine, "rowpacked-bucketed",
+                  bucket=True),
     _HybridRunner(),
 ]
 

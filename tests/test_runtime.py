@@ -1008,12 +1008,15 @@ def test_incremental_link_delta_cr5_over_new_link():
 
 def test_incremental_link_delta_overflowing_pad_rebuilds():
     """More new links than the reserved rows: fall back to rebuild and
-    still match the batch closure."""
+    still match the batch closure.  Exact shapes: a shape-BUCKETED base
+    engine quantizes its link padding up the ladder, so small overflows
+    legitimately fit the bucket headroom and stay on the fast path —
+    the refusal under test is the exact-layout contract."""
     base = "SubClassOf(Pad ObjectSomeValuesFrom(r PadFiller))\n"
     delta = "\n".join(
         f"SubClassOf(L{i} ObjectSomeValuesFrom(r F{i}))" for i in range(40)
     )
-    inc = IncrementalClassifier()
+    inc = IncrementalClassifier(ClassifierConfig(shape_buckets=False))
     inc._FAST_PATH_MIN_CONCEPTS = 0
     inc._LINK_PAD = 0  # no reservation: link deltas must rebuild
     inc.add_text(base)
@@ -1132,19 +1135,50 @@ def test_incremental_role_delta_closure_change_refusal_rebuilds():
     """When the rebind structurally CANNOT express the grown closure —
     here the s-axiom's chunk was dead at build (s satisfies no link)
     and r ⊑ s revives it — the fast path must fall back to the full
-    rebuild and still match the batch closure."""
+    rebuild and still match the batch closure.  Exact shapes: a
+    shape-BUCKETED base engine KEEPS dead chunks as inert window slots,
+    so the rebind revives them in place (see the companion test below)
+    — the refusal under test is the exact-layout contract."""
     base = (
         "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
         "SubClassOf(ObjectSomeValuesFrom(s B) SHit)\n"  # s: no links
         "SubClassOf(B BSup)\n"
     )
     delta = "SubObjectPropertyOf(r s)\n"
-    inc = IncrementalClassifier()
+    inc = IncrementalClassifier(ClassifierConfig(shape_buckets=False))
     inc._FAST_PATH_MIN_CONCEPTS = 0
     inc.add_text(base)
     base_engine = inc._base_engine
     r = inc.add_text(delta)
     assert inc._base_engine is not base_engine, "expected a rebuild"
+    names = {
+        r.idx.concept_names[i]
+        for i in r.subsumers(r.idx.concept_ids["A"])
+        if i < r.idx.n_concepts
+    }
+    assert "SHit" in names
+
+
+def test_incremental_bucketed_base_revives_dead_chunk_on_fast_path():
+    """The bucketed counterpart of the refusal test above: a bucketed
+    base program carries its dead CR4 chunk as inert window slots, so
+    the r ⊑ s delta rebinds IN PLACE — no rebuild — and still reaches
+    the batch closure (the fast path now covers the last delta shape
+    that used to force a recompile)."""
+    base = (
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(s B) SHit)\n"  # s: no links
+        "SubClassOf(B BSup)\n"
+    )
+    delta = "SubObjectPropertyOf(r s)\n"
+    inc = IncrementalClassifier()  # shape_buckets defaults on
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    assert base_engine._bucket
+    r = inc.add_text(delta)
+    assert inc._base_engine is base_engine, "expected the fast path"
+    assert inc.history[-1]["path"] == "fast"
     names = {
         r.idx.concept_names[i]
         for i in r.subsumers(r.idx.concept_ids["A"])

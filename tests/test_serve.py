@@ -417,3 +417,35 @@ def test_cli_serve_sigterm_graceful_spill(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.communicate(timeout=60)
+
+
+# ----------------------------------------- warmup precompile accounting
+
+
+def test_warmup_precompile_makes_same_bucket_load_compile_free(tmp_path):
+    """ISSUE 2 satellite: after the startup warmup precompiles a
+    bucket, loading a DIFFERENT ontology in that bucket reports
+    ``compile_s`` ≈ 0 with a program-cache hit, and the /metrics
+    compile counters move accordingly."""
+    from distel_tpu.frontend.ontology_tools import synthetic_ontology
+
+    kw = dict(
+        n_classes=400, n_anatomy=60, n_locations=40, n_definitions=30
+    )
+    text_a = synthetic_ontology(seed=7, **kw)
+    text_b = synthetic_ontology(seed=99, **kw)
+    warm_file = tmp_path / "warm.ofn"
+    warm_file.write_text(text_a)
+    with serving(warmup_paths=[str(warm_file)]) as (app, client):
+        assert app.warmup_wait(600), "warmup thread never finished"
+        m0 = client.metrics_text()
+        assert _metric(m0, "distel_warmup_programs_total") == 1
+        rec = client.load(text_b)
+        # the load's increment record carries the compile telemetry
+        assert rec["bucket_signature"].startswith("b")
+        assert rec["program_cache_hit"] is True
+        assert rec["compile_s"] == 0.0
+        m1 = client.metrics_text()
+        assert _metric(m1, "distel_program_cache_hits_total") >= 1
+        health = client.healthz()
+        assert health["warmup_done"] is True
