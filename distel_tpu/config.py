@@ -110,6 +110,25 @@ class ClassifierConfig:
     #: persistent XLA compile-cache directory override (None = the
     #: enable_compile_cache default under ~/.cache/distel_tpu)
     compile_cache_dir: Optional[str] = None
+    #: adaptive sparse-tail execution (rowpacked engine, observed runs,
+    #: single device): when a round's frontier density drops below
+    #: ``sparse_density_threshold``, the controller switches from the
+    #: dense step program to a frontier-compacted sparse step that
+    #: gathers only the active rule rows/chunks into a small
+    #: capacity-quantized workspace — late saturation rounds then cost
+    #: what they derive instead of a full corpus sweep
+    sparse_tail: bool = True
+    #: frontier density (active rule rows / total rule rows) below which
+    #: a round is eligible for the sparse tier
+    sparse_density_threshold: float = 0.05
+    #: number of geometric workspace-capacity rungs the sparse tier may
+    #: compile (the roster bound): rung i holds ``floor * 2**i`` rows;
+    #: an active set past the largest rung falls back to the dense step
+    #: for that round (never drops work)
+    sparse_capacity_buckets: int = 8
+    #: consecutive below-threshold rounds required before switching to
+    #: the sparse tier (switching back to dense is immediate)
+    sparse_hysteresis_rounds: int = 2
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -158,10 +177,36 @@ class ClassifierConfig:
             cfg.bucket_ratio = float(raw["bucket.ratio"])
         if "compile.cache.dir" in raw:
             cfg.compile_cache_dir = raw["compile.cache.dir"]
+        if "sparse_tail.enable" in raw:
+            cfg.sparse_tail = raw["sparse_tail.enable"].lower() == "true"
+        if "sparse_tail.density_threshold" in raw:
+            cfg.sparse_density_threshold = float(
+                raw["sparse_tail.density_threshold"]
+            )
+        if "sparse_tail.capacity_buckets" in raw:
+            cfg.sparse_capacity_buckets = int(
+                raw["sparse_tail.capacity_buckets"]
+            )
+        if "sparse_tail.hysteresis_rounds" in raw:
+            cfg.sparse_hysteresis_rounds = int(
+                raw["sparse_tail.hysteresis_rounds"]
+            )
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v
         return cfg
+
+    def sparse_tail_config(self) -> Optional[dict]:
+        """The rowpacked engine's ``sparse_tail=`` kwarg for this config
+        (None = tier disabled)."""
+        if not self.sparse_tail:
+            return None
+        return {
+            "enable": True,
+            "density_threshold": self.sparse_density_threshold,
+            "capacity_buckets": self.sparse_capacity_buckets,
+            "hysteresis_rounds": self.sparse_hysteresis_rounds,
+        }
 
     def matmul_jnp_dtype(self):
         """None means "auto": the engine resolves it against the actual

@@ -89,7 +89,11 @@ from distel_tpu.ops.bitpack import (
     bit_lookup,
     bit_lookup_from,
 )
-from distel_tpu.runtime.instrumentation import CompileStats, compile_watch
+from distel_tpu.runtime.instrumentation import (
+    CompileStats,
+    FrontierStats,
+    compile_watch,
+)
 
 
 #: budget-floor chunk count past which the CR4/CR6 contractions compile
@@ -227,6 +231,44 @@ def _pos_maps(writers, n_rows, dead_rows=(), quantize=None):
     return layers
 
 
+def _window_term(
+    subt, rp_state, fills, lroles, off, live, mask_rows, mm, lcw, dt,
+    wlw, axis_name=None, base=None,
+):
+    """One live L-window's contribution to a CR4/CR6 chunk: the
+    [rk, wlw] packed AND-OR product of the (factored-mask ∧ bit-table ∧
+    ``live``) operand against the window's R rows.  ``lcw`` is the
+    rule's window length (CR4 may run finer windows than CR6 — see
+    ``lc4`` in ``__init__``).  ``live`` zeroes the operand when nothing
+    the window reads changed last step — OR-monotone, so skipping only
+    delays; the Pallas kernel's per-tile skip flags then drop the MXU
+    work.  THE one window-term formulation, shared verbatim by the
+    unrolled, scanned AND sparse-tier step programs (the parity tests
+    pin them bit-identical).  Window contents slice the SHARED
+    filler/link-role tables (stacked per-chunk copies would replicate
+    them ×n_chunks in the run arguments)."""
+    fcols = lax.dynamic_slice(fills, (off,), (lcw,))
+    lrole = lax.dynamic_slice(lroles, (off,), (lcw,))
+    with jax.named_scope("bit_table"):
+        if axis_name is None:
+            f = bit_lookup_from(subt, fcols, dtype=dt)
+        else:
+            f = lax.psum(
+                bit_lookup_from(
+                    subt, fcols, word_offset=base, dtype=jnp.int32,
+                ),
+                axis_name,
+            ).astype(dt)                                  # [lc, rk]
+    # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
+    w = (
+        jnp.take(mask_rows, lrole, axis=1).astype(dt)
+        * f.T
+        * live.astype(dt)
+    )
+    b = lax.dynamic_slice(rp_state, (off, 0), (lcw, wlw))
+    return mm(w, b)
+
+
 class RowPackedSaturationEngine:
     """Compiles an indexed ontology into a jitted fixed point over
     transposed row-packed state.  API mirrors ``SaturationEngine``:
@@ -267,6 +309,7 @@ class RowPackedSaturationEngine:
         window_headroom: int = 0,
         bucket: bool = False,
         bucket_ratio: float = 1.25,
+        sparse_tail: Optional[dict] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -324,7 +367,21 @@ class RowPackedSaturationEngine:
         Bucket mode forces ``scan_chunks`` for CR4/CR6 (the unrolled
         per-chunk formulation's structure is not canonicalized) and
         plain row-budget chunk spans (role-aware splitting is
-        data-dependent)."""
+        data-dependent).
+        ``sparse_tail``: adaptive sparse-tail execution config (None =
+        off): ``saturate_observed`` then runs a host-side controller
+        that switches low-frontier-density rounds onto a
+        frontier-compacted step program — active rule rows/chunks
+        gathered into a small capacity-quantized workspace, all indices
+        carried as runtime args so sparse programs share executables
+        through ``core/program_cache.PROGRAMS`` exactly like dense
+        ones.  Keys: ``enable``, ``density_threshold``,
+        ``capacity_buckets``, ``hysteresis_rounds``, plus the
+        workspace floor ``capacity_floor``.  Single-device,
+        scanned-CR4/CR6 engines only (the controller quietly stays
+        dense otherwise); overflow past the largest workspace rung
+        falls back to the dense step for that round — work is delayed
+        at most, never dropped."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
@@ -487,6 +544,10 @@ class RowPackedSaturationEngine:
         )
         nf3 = idx.nf3 if on("CR3") else empty2
         self._p3, self._src3 = _rule_plan(nf3, 1, (0,), self._dead_l)
+        # raw (unpermuted) CR1-CR3 tables: the sparse tier's host-side
+        # active-set compaction selects rows against these — the plans
+        # above are emission-permuted and quantization-padded
+        self._sp_nf1, self._sp_nf2, self._sp_nf3 = nf1, nf2, nf3
 
         # CR4/CR6 row tables (chunking, masks and link-table arrays are
         # built later, once the final padded link-axis width is known).
@@ -809,6 +870,27 @@ class RowPackedSaturationEngine:
             idx.chain_pairs[:, 0] if self._has6 else None,
             n_pad=self._n_roles_pad,
         )
+        # host copies for the sparse tier's row-granular CR4/CR6
+        # activity fold (rebind_role_closure refreshes them): the full
+        # factored-mask tables AS BOOL (the fold runs every round —
+        # converting per round would copy the whole table each time),
+        # plus which roles each L-chunk carries (dirty chunks -> dirty
+        # roles -> rows whose masks cover one)
+        self._m4_full = m4.astype(bool)
+        self._m6_full = m6.astype(bool)
+        self._chunk_roles_np = np.zeros(
+            (self.n_lchunks, self._n_roles_pad + 1), bool
+        )
+        self._chunk_roles_np[
+            np.arange(self.nl) // self.lc, self._link_roles
+        ] = True
+        # maximal dirty-role fold (every L-chunk dirty) and each
+        # table's row activity under it, precomputed so the all-dirty
+        # early rounds of every observed run — which are certain to
+        # stay dense — skip the O(rows × roles) masked fold
+        self._max_dirty_roles = self._chunk_roles_np.any(axis=0)
+        self._m4_any = (self._m4_full & self._max_dirty_roles).any(axis=1)
+        self._m6_any = (self._m6_full & self._max_dirty_roles).any(axis=1)
 
         # ---- static live-tile schedule: each CR4/CR6 row chunk
         # contracts ONLY the L-windows containing links whose role is a
@@ -961,7 +1043,16 @@ class RowPackedSaturationEngine:
                 if want_readers:
                     reader_rows.append(rows_src[a0:a1])
             if not rows_l:
-                return None
+                # every span dead: no program structure to build, but
+                # PERSIST the span grid — rebind_role_closure must check
+                # exactly these boundaries when a grown closure could
+                # revive one (re-deriving them later risks desync with
+                # the grid actually used here)
+                return {"empty": True, "spans_dropped": spans_dropped}
+            n_grid = len(tab_roles) if n_rows is None else n_rows
+            pos_of = np.full(n_grid, -1, np.int64)
+            for i, (a0, a1) in enumerate(spans_kept):
+                pos_of[a0:a1] = i * rk + np.arange(a1 - a0)
             nch = len(rows_l)
             n_windows = np.asarray([len(o) for o in offs_l])
             # reserve slots stay tval=False until rebind_role_closure
@@ -1053,6 +1144,17 @@ class RowPackedSaturationEngine:
                 "spans_dropped": spans_dropped,
                 "group_args": tuple(group_args),
                 "pad_target": pad_target,
+                # host copies for the sparse tier's per-round activity
+                # fold (the slabs above are device arrays);
+                # rebind_role_closure refreshes tval alongside the
+                # slab swap
+                "tval_np": tval_s,
+                "tgt_rows_np": np.stack(tgt_l),
+                # table row -> flat slab position (chunk*rk + offset);
+                # -1 for rows of dropped spans (absent from the program
+                # — and provably inert: a dropped span's roles satisfy
+                # no link, so no frontier can activate its rows)
+                "pos_of_row": pos_of,
             }
 
         # the whole plan-table pytree (closure masks + live-tile
@@ -1061,7 +1163,16 @@ class RowPackedSaturationEngine:
         # and replicated per shard
         if self._scan_mode:
             rk4, rk6 = self._scan_rk
-            self._scan4 = (
+
+            def _settle(d):
+                """(scan dict | None, persisted all-dropped spans)."""
+                if d is None:
+                    return None, []
+                if d.get("empty"):
+                    return None, d["spans_dropped"]
+                return d, []
+
+            self._scan4, self._scan4_dropped = _settle(
                 build_scan(
                     rk4, self.lc4, idx.nf4[:, 0], self._a4,
                     idx.nf4[:, 2], m4, self._a4, self.nc,
@@ -1071,7 +1182,7 @@ class RowPackedSaturationEngine:
                 if self._has4
                 else None
             )
-            self._scan6 = (
+            self._scan6, self._scan6_dropped = _settle(
                 build_scan(
                     rk6, self.lc, idx.chain_pairs[:, 0], self._l26,
                     idx.chain_pairs[:, 2], m6,
@@ -1093,6 +1204,7 @@ class RowPackedSaturationEngine:
             )
         else:
             self._scan4 = self._scan6 = None
+            self._scan4_dropped = self._scan6_dropped = []
             self._cr4_chunks, self._cr4_tiles, self._cr4_dropped_roles = (
                 build_tiles(
                     self._cr4_chunks, lambda raw: idx.nf4[raw, 0], self.lc4
@@ -1118,6 +1230,15 @@ class RowPackedSaturationEngine:
         self._live_windows = live_windows
         self._make_pad_window = _pad_window
 
+        #: density denominator of the sparse-tail controller: total
+        #: REAL rule-table rows a fully-dirty round re-evaluates
+        self._sp_total_rows = (
+            len(nf1) + len(nf2) + len(nf3)
+            + (len(idx.nf4) if self._has4 else 0)
+            + (len(idx.chain_pairs) if self._has6 else 0)
+            + (1 if self._bottom else 0)
+        )
+
         # one packed-output matmul plan per row-chunk, shared by every
         # (equal-sized) L-window.  dtype: forwarded only when the caller
         # pinned one — the Pallas kernel's own default (bf16 on TPU) wins
@@ -1128,6 +1249,7 @@ class RowPackedSaturationEngine:
             mm_kw["dtype"] = matmul_dtype
         if mm_opts:
             mm_kw.update(mm_opts)
+        self._mm_kw = dict(mm_kw)  # the sparse tier builds its own plans
         wl = self.wc // self.n_shards
         if self._scan_mode:
 
@@ -1289,6 +1411,15 @@ class RowPackedSaturationEngine:
         #: bucket mode)
         self._aot_runs: dict = {}
         self._aot_step = None
+        #: sparse-tail tier state: normalized controller config,
+        #: per-capacity AOT executables, build telemetry, per-round
+        #: frontier records of the last saturate_observed run
+        self._sparse_cfg = self._normalize_sparse_cfg(sparse_tail)
+        self._aot_sparse: dict = {}
+        self._sparse_builds: list = []
+        self._sparse_const_cache = None
+        self._sparse_mm: dict = {}
+        self.frontier_rounds: list = []
         self._stats_lock = threading.Lock()
         #: accumulated program-build telemetry for this engine
         self.compile_stats = CompileStats(
@@ -1598,6 +1729,564 @@ class RowPackedSaturationEngine:
             jnp.ones(self.nc, bool),
         )
 
+    # ---------------------------------------------- sparse-tail tier
+    #
+    # Semi-naive saturation means late rounds derive little, yet the
+    # dense step still sweeps every rule table and the full CR4/CR6
+    # chunk grid each round (gating zeroes the operands but the
+    # gathers, window slices and scan bodies all execute).  The sparse
+    # tier makes tail rounds cost what they derive: a host-side
+    # controller (see ``saturate_observed``) folds each round's
+    # frontier to the host, compacts the ACTIVE rule rows (CR1-CR3 at
+    # row granularity) and active CR4/CR6 chunks (the dense program's
+    # own gating granularity) into small capacity-quantized workspace
+    # arrays, and runs them through ``_sparse_exec`` — a second step
+    # program whose jaxpr depends only on the workspace capacities and
+    # the engine's structural shapes.  All indices ride as runtime
+    # args, so sparse programs share executables through PROGRAMS
+    # exactly like dense ones.  Selection mirrors the dense step's
+    # gating semantics exactly (same masks, same granularity, same
+    # intra-step read/write order), so an adaptive run is
+    # byte-identical PER ROUND to a dense-only run — the property
+    # tests/test_sparse_tail.py pins.
+
+    _SPARSE_DEFAULTS = {
+        "enable": True,
+        "density_threshold": 0.05,
+        "capacity_buckets": 8,
+        "hysteresis_rounds": 2,
+        "capacity_floor": 64,
+    }
+
+    @classmethod
+    def _normalize_sparse_cfg(cls, raw) -> Optional[dict]:
+        if not raw:
+            return None
+        cfg = dict(cls._SPARSE_DEFAULTS)
+        if raw is not True:
+            unknown = set(raw) - set(cfg)
+            if unknown:
+                raise ValueError(
+                    f"unknown sparse_tail keys: {sorted(unknown)}"
+                )
+            cfg.update(raw)
+        if not cfg["enable"]:
+            return None
+        # reject degenerate values at load, not rounds deep into a run:
+        # capacity_buckets < 1 would shift by a negative count in
+        # _sparse_rung, capacity_floor < 1 breaks the rung ladder, and
+        # hysteresis < 1 silently means "always eligible" — the
+        # controller would pick the sparse tier regardless of density
+        if int(cfg["capacity_buckets"]) < 1 or int(cfg["capacity_floor"]) < 1:
+            raise ValueError(
+                "sparse_tail capacity_buckets and capacity_floor must "
+                f"be >= 1 (got {cfg['capacity_buckets']!r}, "
+                f"{cfg['capacity_floor']!r})"
+            )
+        if int(cfg["hysteresis_rounds"]) < 1:
+            raise ValueError(
+                "sparse_tail hysteresis_rounds must be >= 1 "
+                f"(got {cfg['hysteresis_rounds']!r})"
+            )
+        return cfg
+
+    def _sparse_supported(self) -> bool:
+        """The tier's support matrix: single device (the sharded
+        sparse tier is the ROADMAP multichip follow-up), and CR4/CR6 —
+        when present — in the scanned-chunk formulation (the sparse
+        program rides their slabs; bucket mode always scans)."""
+        if self.mesh is not None:
+            return False
+        if (self._has4 or self._has6) and not self._scan_mode:
+            return False
+        return True
+
+    @staticmethod
+    def _sparse_rung(cfg: dict, n: int, floor: int) -> Optional[int]:
+        """Smallest workspace rung >= ``n`` on the power-of-two family
+        of the program-cache ladder (``bucket_dim``, ratio 2), or None
+        when ``n`` overflows the largest of the ``capacity_buckets``
+        configured rungs — the caller then falls back to the dense
+        step for the round."""
+        rung = bucket_dim(max(int(n), 1), 2.0, floor=floor)
+        if rung > floor << (int(cfg["capacity_buckets"]) - 1):
+            return None
+        return rung
+
+    def _sparse_round_plan(self, cfg, s_chg, dirty_l, any_r):
+        """Host-side measure + active-set selection for one round.
+        Returns ``(rows_touched, density, measure, overflow)``;
+        ``measure`` holds the selected row sets + workspace key and is
+        None on workspace overflow (``overflow`` True) — the round then
+        runs dense, never dropping work.  The controller turns a
+        measure into program arguments with :meth:`_sparse_round_args`
+        only once it actually picks the sparse tier (dense rounds pay
+        just the selection fold, not the workspace padding).
+
+        Selection replicates the dense step's gating EXACTLY, extended
+        with its intra-step cascade: CR1 selects on the previous
+        round's changed-S mask (dense CR1 reads pre-step S); CR2 also
+        covers readers of active CR1 targets (dense CR2 reads S after
+        CR1's writes — potential targets whose write turns out clean
+        contribute nothing new under monotone OR); CR3 covers CR1/CR2
+        targets likewise.  CR4/CR6 select at ROW granularity: a row is
+        active iff its bit-table source row changed (CR4: the S row
+        ``a4[j]``; CR6: the chunk of R row ``l2[p]``) or its factored
+        mask covers a role present in a dirty L-chunk — rows outside
+        that set provably contribute nothing new even in the dense
+        step (their operand inputs are unchanged), so per-round
+        derivation counts stay byte-identical to a dense-only run
+        while the tail's cost tracks the true frontier, not the dense
+        chunk grid."""
+        nf1, nf2, nf3 = self._sp_nf1, self._sp_nf2, self._sp_nf3
+        empty = np.zeros(0, np.int64)
+        act1 = np.flatnonzero(s_chg[nf1[:, 0]]) if len(nf1) else empty
+        s1 = s_chg
+        if act1.size:
+            s1 = s_chg.copy()
+            s1[nf1[act1, 1]] = True
+        act2 = (
+            np.flatnonzero(s1[nf2[:, 0]] | s1[nf2[:, 1]])
+            if len(nf2)
+            else empty
+        )
+        s2 = s1
+        if act2.size:
+            s2 = s1.copy() if s1 is s_chg else s1
+            s2[nf2[act2, 2]] = True
+        act3 = np.flatnonzero(s2[nf3[:, 0]]) if len(nf3) else empty
+
+        # dirty chunks -> dirty roles: the role-granular over-
+        # approximation of "some link this row's mask covers changed"
+        dirty_roles = self._chunk_roles_np[dirty_l].any(axis=0)
+
+        def row_act(d, mask_tab, mask_any, fd_rows):
+            """Active CR4/CR6 rows: source changed (``fd_rows``) or
+            mask covers a dirty role; rows of dropped spans (slab
+            position -1) and of chunks with no live windows are inert
+            in the compiled program and excluded."""
+            if np.array_equal(dirty_roles, self._max_dirty_roles):
+                # all roles dirty (early rounds): the precomputed
+                # per-row activity, no table-sized temporary
+                masked = mask_any[: len(fd_rows)]
+            else:
+                masked = (
+                    mask_tab[: len(fd_rows)] & dirty_roles
+                ).any(axis=1)
+            act = fd_rows | masked
+            pos = d["pos_of_row"][: len(fd_rows)]
+            has_win = d["tval_np"].any(axis=1)
+            ok = (pos >= 0) & has_win[np.clip(pos, 0, None) // d["rk"]]
+            return np.flatnonzero(act & ok)
+
+        act4 = act6 = empty
+        fd4 = fd6 = None
+        if self._scan4 is not None:
+            fd4 = s_chg[self._a4]
+            act4 = row_act(self._scan4, self._m4_full, self._m4_any, fd4)
+        if self._scan6 is not None:
+            fd6 = dirty_l[self._l26 // self.lc]
+            act6 = row_act(self._scan6, self._m6_full, self._m6_any, fd6)
+        run5 = bool(self._bottom and (any_r or s_chg[BOTTOM_ID]))
+        rows_touched = int(
+            act1.size + act2.size + act3.size + act4.size + act6.size
+            + (1 if run5 else 0)
+        )
+        density = rows_touched / max(self._sp_total_rows, 1)
+        floor = cfg["capacity_floor"]
+        c123 = self._sparse_rung(
+            cfg, max(act1.size, act2.size, act3.size), floor
+        )
+        a4 = self._sparse_rung(cfg, act4.size, floor) if act4.size else 0
+        a6 = self._sparse_rung(cfg, act6.size, floor) if act6.size else 0
+        if c123 is None or a4 is None or a6 is None:
+            return rows_touched, density, None, True
+        measure = {
+            "act1": act1, "act2": act2, "act3": act3,
+            "act4": act4, "act6": act6, "fd4": fd4, "fd6": fd6,
+            "run5": run5, "key": (c123, a4, a6),
+        }
+        return rows_touched, density, measure, False
+
+    def _sparse_round_args(self, measure, dirty_l):
+        """Compact one round's selected row sets (a
+        :meth:`_sparse_round_plan` measure) into the padded workspace
+        arrays of the sparse program — called only on rounds the
+        controller actually runs sparse."""
+        nf1, nf2, nf3 = self._sp_nf1, self._sp_nf2, self._sp_nf3
+        empty = np.zeros(0, np.int64)
+        act1, act2, act3 = (
+            measure["act1"], measure["act2"], measure["act3"],
+        )
+        c123, a4, a6 = measure["key"]
+
+        def pad_idx(a, n, fill=0):
+            out = np.full(n, fill, np.int32)
+            out[: len(a)] = a
+            return out
+
+        def val_mask(k, n):
+            v = np.zeros(n, np.uint32)
+            v[:k] = 0xFFFFFFFF
+            return v
+
+        args = {
+            "src1": pad_idx(nf1[act1, 0] if act1.size else empty, c123),
+            "tgt1": pad_idx(nf1[act1, 1] if act1.size else empty, c123),
+            "val1": val_mask(act1.size, c123),
+            "src2a": pad_idx(nf2[act2, 0] if act2.size else empty, c123),
+            "src2b": pad_idx(nf2[act2, 1] if act2.size else empty, c123),
+            "tgt2": pad_idx(nf2[act2, 2] if act2.size else empty, c123),
+            "val2": val_mask(act2.size, c123),
+            "src3": pad_idx(nf3[act3, 0] if act3.size else empty, c123),
+            "tgt3": pad_idx(nf3[act3, 1] if act3.size else empty, c123),
+            "val3": val_mask(act3.size, c123),
+            "dirty_l": np.asarray(dirty_l, bool),
+        }
+        if self._bottom:
+            args["run5"] = np.bool_(measure["run5"])
+
+        def row_args(d, act, fd_rows, prefix, a):
+            g_of = d.get("g_of")
+            if g_of is None:
+                g_of = np.zeros(d["nch"], np.int32)
+                for gi, (g0, g1, _p, _r) in enumerate(d["groups"]):
+                    g_of[g0:g1] = gi
+                d["g_of"] = g_of
+            pos = d["pos_of_row"][act]
+            args["sel" + prefix] = pad_idx(pos, a)
+            fdp = np.zeros(a, bool)
+            fdp[: act.size] = fd_rows[act]
+            args["fd" + prefix] = fdp
+            # pad slots park on position 0 with wave -1: no group pass
+            # matches, so their operands zero out and writes are no-ops
+            args["wave" + prefix] = pad_idx(
+                g_of[(pos // d["rk"]).astype(np.int64)], a, fill=-1
+            )
+
+        if a4:
+            row_args(self._scan4, measure["act4"], measure["fd4"], "4", a4)
+        if a6:
+            row_args(self._scan6, measure["act6"], measure["fd6"], "6", a6)
+        return {"args": args, "key": measure["key"]}
+
+    def _sparse_consts(self) -> dict:
+        """Device-resident sparse-program arguments that are stable
+        across rounds (built once; slab leaves are read live because
+        ``rebind_role_closure`` swaps them)."""
+        c = self._sparse_const_cache
+        if c is None:
+            c = {
+                "wmask": jnp.asarray(self._wmask),
+                "fills": jnp.asarray(self._fillers.astype(np.int32)),
+                "lroles": jnp.asarray(self._link_roles),
+            }
+            if self._scan4 is not None:
+                c["tgt4_flat"] = jnp.asarray(
+                    self._scan4["tgt_rows_np"].reshape(-1).astype(np.int32)
+                )
+            if self._scan6 is not None:
+                c["tgt6_flat"] = jnp.asarray(
+                    self._scan6["tgt_rows_np"].reshape(-1).astype(np.int32)
+                )
+            self._sparse_const_cache = c
+        return c
+
+    def _sparse_args(self, plan: dict) -> dict:
+        sa = dict(plan["args"])
+        c = self._sparse_consts()
+        sa["wmask"], sa["fills"], sa["lroles"] = (
+            c["wmask"], c["fills"], c["lroles"],
+        )
+        if "sel4" in sa:
+            sa["tgt4_flat"] = c["tgt4_flat"]
+            sa["slabs4"] = self._scan4["slabs"]
+        if "sel6" in sa:
+            sa["tgt6_flat"] = c["tgt6_flat"]
+            sa["slabs6"] = self._scan6["slabs"]
+        return sa
+
+    def _sparse_mm_plan(self, lcn: int):
+        """Single-row matmul plan of the sparse tier's per-row
+        contraction (cf. ``scan_mm`` in ``__init__`` — same kwargs,
+        rk=1)."""
+        plan = self._sparse_mm.get(lcn)
+        if plan is None:
+            kw2 = dict(self._mm_kw)
+            if kw2.get("use_xla") and "tm" not in kw2:
+                kw2["tm"] = 8
+            plan = PackedColsMatmulPlan(
+                1, lcn, self.wc // self.n_shards, **kw2
+            )
+            self._sparse_mm[lcn] = plan
+        return plan
+
+    def _sparse_avals(self, c123: int, a4: int, a6: int) -> dict:
+        def av(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        sa = {
+            "wmask": av((self.wc,), jnp.uint32),
+            "fills": av((self.nl,), jnp.int32),
+            "lroles": av((self.nl,), jnp.int32),
+            "dirty_l": av((self.n_lchunks,), jnp.bool_),
+        }
+        for k in ("src1", "tgt1", "src2a", "src2b", "tgt2", "src3",
+                  "tgt3"):
+            sa[k] = av((c123,), jnp.int32)
+        for k in ("val1", "val2", "val3"):
+            sa[k] = av((c123,), jnp.uint32)
+        if self._bottom:
+            sa["run5"] = av((), jnp.bool_)
+        for a, d, prefix in (
+            (a4, self._scan4, "4"), (a6, self._scan6, "6"),
+        ):
+            if not a or d is None:
+                continue
+            sa["sel" + prefix] = av((a,), jnp.int32)
+            sa["fd" + prefix] = av((a,), jnp.bool_)
+            sa["wave" + prefix] = av((a,), jnp.int32)
+            sa["tgt" + prefix + "_flat"] = av(
+                (d["nch"] * d["rk"],), jnp.int32
+            )
+            sa["slabs" + prefix] = jax.tree_util.tree_map(
+                lambda x: av(np.shape(x), jnp.asarray(x).dtype),
+                d["slabs"],
+            )
+        return sa
+
+    def _sparse_exec(self, sp, rp, sa):
+        """One frontier-compacted superstep — the sparse tier's traced
+        program.  Rule order and intra-step read/write structure mirror
+        :meth:`_step` verbatim (CR1 → CR2 → CR3 → CR4 groups in dense
+        group order → CR6 groups → CR5, each reading exactly the state
+        its dense counterpart reads), which is what makes an adaptive
+        run byte-identical per round to a dense-only run.  Every
+        ontology-derived value arrives in ``sa`` — compacted active-row
+        indices + validity masks, selected chunk ids over the scanned
+        slabs, the shared filler/link-role tables — so the jaxpr is a
+        pure function of the workspace capacities and the engine's
+        structural shapes (bucket mode shares executables across
+        same-bucket ontologies through PROGRAMS).  Pad workspace slots
+        carry ``val=0`` / wave ``-1`` and reduce to OR-identity no-op
+        writes.  Returns ``(sp, rp, changed, delta_bits, mask_s,
+        any_r, dirty_l_next)`` — the frontier fold the host controller
+        carries into the next round; ``delta_bits`` counts new
+        live-column bits so tail rounds skip the full live-bits sweep.
+        Single-device only."""
+        width = sp.shape[1]
+        wmask = sa["wmask"]
+        dt = self.matmul_dtype
+        delta = jnp.asarray(0, jnp.int32)
+        changed = jnp.asarray(False)
+        mask_s = jnp.zeros(self.nc, bool)
+        mask_r = jnp.zeros(self.nl, bool)
+
+        def write_seq(state, mask_vec, tgts, contribs, delta, changed):
+            """Sequential OR-writes of ``contribs`` [n, width] into
+            ``state`` rows ``tgts`` with per-write change tracking —
+            the sparse analog of the dense seg-OR write.  Sequencing
+            makes duplicate targets exact under OR, and n is workspace-
+            bounded, so the per-row scatter cost the dense engine
+            avoids stays microseconds here."""
+
+            def body(i, car):
+                st, mv, d, ch = car
+                t = tgts[i]
+                old = st[t]
+                gained = contribs[i] & ~old
+                chg = jnp.any(gained != 0)
+                st = st.at[t].set(old | contribs[i])
+                mv = mv.at[t].set(mv[t] | chg)
+                d = d + jnp.sum(
+                    lax.population_count(gained & wmask),
+                    dtype=jnp.int32,
+                )
+                return st, mv, d, ch | chg
+
+            return lax.fori_loop(
+                0, tgts.shape[0], body,
+                (state, mask_vec, delta, changed),
+            )
+
+        # CR1/CR2/CR3 over the compacted row workspace; gathers happen
+        # before each rule's writes and after the previous rule's —
+        # the dense block sweep's effective read/write order
+        if len(self._sp_nf1):
+            contrib = sp[sa["src1"]] & sa["val1"][:, None]
+            sp, mask_s, delta, changed = write_seq(
+                sp, mask_s, sa["tgt1"], contrib, delta, changed
+            )
+        if len(self._sp_nf2):
+            contrib = (sp[sa["src2a"]] & sp[sa["src2b"]]) \
+                & sa["val2"][:, None]
+            sp, mask_s, delta, changed = write_seq(
+                sp, mask_s, sa["tgt2"], contrib, delta, changed
+            )
+        if len(self._sp_nf3):
+            contrib = sp[sa["src3"]] & sa["val3"][:, None]
+            rp, mask_r, delta, changed = write_seq(
+                rp, mask_r, sa["tgt3"], contrib, delta, changed
+            )
+
+        dl = sa["dirty_l"]
+
+        def scan_sel(d, slabs, sel, fd, wave, mm, src_state, rp_state,
+                     gi):
+            """Contract the SELECTED rows of one rule at single-row
+            shapes over their chunks' window tables, one group pass:
+            rows outside group ``gi`` (and pad slots, wave -1) zero out
+            via the live multiplier, preserving the dense
+            group-sequential cascade.  ``sel`` holds flat slab
+            positions (chunk*rk + offset); ``live`` is the dense
+            formula with fd at ROW granularity — a strict refinement
+            of the chunk flag that derives the identical new facts."""
+            rows_s, _fdx_s, m_s, offs_s, c01_s, tval_s = slabs
+            rk, T, lcn = d["rk"], d["T"], d["lcn"]
+            ch_of = sel // rk
+            xs = (
+                rows_s.reshape(-1)[sel],
+                m_s.reshape(-1, m_s.shape[-1])[sel],
+                offs_s[ch_of], c01_s[ch_of], tval_s[ch_of],
+                fd, wave,
+            )
+
+            def one_row(_, xs):
+                row_k, m_k, offs_k, c01_k, tval_k, fd_k, w_k = xs
+                subt = src_state[row_k][:, None]      # [width, 1]
+
+                def one(i, acc):
+                    live = (
+                        (w_k == gi)
+                        & tval_k[i]
+                        & (dl[c01_k[i, 0]] | dl[c01_k[i, 1]] | fd_k)
+                    )
+                    return acc | _window_term(
+                        subt, rp_state, sa["fills"], sa["lroles"],
+                        offs_k[i], live, m_k[None], mm, lcn, dt,
+                        width,
+                    )
+
+                z = jnp.zeros((1, width), jnp.uint32)
+                acc = one(0, z) if T == 1 else lax.fori_loop(
+                    0, T, one, z
+                )
+                return (), acc[0]
+
+            _, ys = lax.scan(one_row, (), xs)
+            return ys
+
+        if "sel4" in sa:
+            d4 = self._scan4
+            mm4 = self._sparse_mm_plan(d4["lcn"])
+            tg4 = sa["tgt4_flat"][sa["sel4"]]
+            with jax.named_scope("cr4"):
+                for gi in range(len(d4["groups"])):
+                    contrib = scan_sel(
+                        d4, sa["slabs4"], sa["sel4"], sa["fd4"],
+                        sa["wave4"], mm4, sp, rp, gi,
+                    )
+                    sp, mask_s, delta, changed = write_seq(
+                        sp, mask_s, tg4, contrib, delta, changed
+                    )
+        if "sel6" in sa:
+            d6 = self._scan6
+            mm6 = self._sparse_mm_plan(d6["lcn"])
+            tg6 = sa["tgt6_flat"][sa["sel6"]]
+            with jax.named_scope("cr6"):
+                for gi in range(len(d6["groups"])):
+                    contrib = scan_sel(
+                        d6, sa["slabs6"], sa["sel6"], sa["fd6"],
+                        sa["wave6"], mm6, rp, rp, gi,
+                    )
+                    rp, mask_r, delta, changed = write_seq(
+                        rp, mask_r, tg6, contrib, delta, changed
+                    )
+
+        if self._bottom:
+
+            def red5(ops):
+                s, r = ops
+                botf = bit_lookup(
+                    s, np.full(1, BOTTOM_ID), sa["fills"], dtype=dt
+                )
+                bmask = botf[:, 0].astype(bool)
+                masked = jnp.where(
+                    bmask[:, None], r, jnp.asarray(0, jnp.uint32)
+                )
+                return lax.reduce(
+                    masked, np.uint32(0), lax.bitwise_or, (0,)
+                )[None]
+
+            with jax.named_scope("cr5"):
+                red = lax.cond(
+                    sa["run5"],
+                    red5,
+                    lambda _ops: jnp.zeros((1, width), jnp.uint32),
+                    (sp, rp),
+                )
+                old5 = sp[BOTTOM_ID]
+                gained = red[0] & ~old5
+                chg = jnp.any(gained != 0)
+                sp = sp.at[BOTTOM_ID].set(old5 | red[0])
+                mask_s = mask_s.at[BOTTOM_ID].set(
+                    mask_s[BOTTOM_ID] | chg
+                )
+                delta = delta + jnp.sum(
+                    lax.population_count(gained & wmask),
+                    dtype=jnp.int32,
+                )
+                changed = changed | chg
+
+        with jax.named_scope("frontier"):
+            any_r = jnp.any(mask_r)
+            dirty_l_next = mask_r.reshape(
+                self.n_lchunks, self.lc
+            ).any(axis=1)
+        return sp, rp, changed, delta, mask_s, any_r, dirty_l_next
+
+    def _sparse_aot(self, c123: int, a4: int, a6: int):
+        """Compiled sparse-step executable for one workspace-capacity
+        triple — same registry/caching story as :meth:`_run_aot`: in
+        bucket mode same-bucket engines share the executable through
+        PROGRAMS (capacities ride in the key), and the XLA compile of
+        the byte-identical HLO is normally a persistent-cache hit."""
+        key = (c123, a4, a6)
+        exe = self._aot_sparse.get(key)
+        if exe is not None:
+            return exe
+        stats = CompileStats(
+            bucket_signature=self.bucket_signature,
+            program=f"sparse[{c123},{a4},{a6}]",
+        )
+        sp_av = jax.ShapeDtypeStruct((self.nc, self.wc), jnp.uint32)
+        rp_av = jax.ShapeDtypeStruct((self.nl, self.wc), jnp.uint32)
+        sa_av = self._sparse_avals(c123, a4, a6)
+
+        def build():
+            t0 = time.perf_counter()
+            lowered = jax.jit(
+                self._sparse_exec, donate_argnums=(0, 1)
+            ).lower(sp_av, rp_av, sa_av)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            stats.trace_lower_s = t1 - t0
+            stats.compile_s = time.perf_counter() - t1
+            return compiled
+
+        with compile_watch(stats):
+            if self._bucket:
+                exe, hit = PROGRAMS.get_or_build(
+                    (self.bucket_signature, "sparse", key), build
+                )
+                stats.program_cache_hit = hit
+            else:
+                exe = build()
+        self._aot_sparse[key] = exe
+        self._sparse_builds.append(stats)
+        self._note_compile(stats)
+        return exe
+
     # ------------------------------------------- programs & compilation
 
     def _compute_signature(self) -> str:
@@ -1749,7 +2438,7 @@ class RowPackedSaturationEngine:
         self,
         max_iters: int = 10_000,
         *,
-        programs: Tuple[str, ...] = ("run", "step"),
+        programs: Tuple[str, ...] = ("run", "step", "sparse"),
         parallel: Optional[bool] = None,
         max_workers: Optional[int] = None,
     ) -> CompileStats:
@@ -1777,6 +2466,28 @@ class RowPackedSaturationEngine:
                 "run": lambda: self._run_aot(budget),
                 "step": self._step_aot,
             }
+            if self._sparse_cfg is not None and self._sparse_supported():
+                # the sparse tier's floor-rung programs — the
+                # capacities tail rounds actually resolve to: the
+                # S-rules-only key (a4 = a6 = 0, the subclass-chain
+                # tail regime) and, when CR4/CR6 exist, the mixed key
+                # with their row workspaces at the floor.  Larger
+                # rungs compile lazily (and usually hit the
+                # persistent cache).
+                cfg = self._sparse_cfg
+
+                def sparse_floor():
+                    floor = cfg["capacity_floor"]
+                    self._sparse_aot(floor, 0, 0)
+                    mixed = (
+                        floor,
+                        floor if self._scan4 else 0,
+                        floor if self._scan6 else 0,
+                    )
+                    if mixed != (floor, 0, 0):
+                        self._sparse_aot(*mixed)
+
+                roster["sparse"] = sparse_floor
             tasks = [roster[name] for name in programs if name in roster]
         else:
 
@@ -1884,11 +2595,19 @@ class RowPackedSaturationEngine:
                 if d is None:
                     # the rule had NO live chunk at build (or no rows):
                     # a grown closure reviving any span needs a program
-                    # this engine never compiled
+                    # this engine never compiled.  Consume the spans
+                    # PERSISTED by build_scan (the shared _chunk_spans
+                    # grid) — re-deriving boundaries here from
+                    # self._scan_rk could silently desync from the grid
+                    # the build actually dropped, misjudging liveness.
+                    dropped = (
+                        self._scan4_dropped
+                        if key == "s4"
+                        else self._scan6_dropped
+                    )
                     if tab_roles is not None and len(tab_roles):
-                        rk = self._scan_rk[0 if key == "s4" else 1]
                         lcn = self.lc4 if key == "s4" else self.lc
-                        for a0, a1 in _chunk_spans(len(tab_roles), rk):
+                        for a0, a1 in dropped:
                             if self._live_windows(
                                 tab_roles[a0:a1], lcn, h_arg=h_new
                             ) is not None:
@@ -1925,13 +2644,18 @@ class RowPackedSaturationEngine:
                 new_slabs[key + "_nw"] = np.asarray(
                     [len(o) for o in offs_l]
                 )
+                # host copy for the sparse tier's chunk-activity fold
+                # must track the slab swap
+                new_slabs[key + "_np"] = tval_s
             # ---- all checks passed: swap atomically
             if self._scan4 is not None:
                 self._scan4["slabs"] = new_slabs["s4"]
                 self._scan4["n_windows"] = new_slabs["s4_nw"]
+                self._scan4["tval_np"] = new_slabs["s4_np"]
             if self._scan6 is not None:
                 self._scan6["slabs"] = new_slabs["s6"]
                 self._scan6["n_windows"] = new_slabs["s6_nw"]
+                self._scan6["tval_np"] = new_slabs["s6_np"]
             if self._bucket:
                 # same compiled program, new argument content: only the
                 # slab leaves change — shapes (and so the signature and
@@ -1991,6 +2715,15 @@ class RowPackedSaturationEngine:
             )
         import dataclasses
 
+        # the sparse tier's host-side activity fold reads the full
+        # factored-mask tables — refresh them with the grown closure,
+        # as bool like the build-time cache, along with the derived
+        # all-dirty row activity (chunk→role coverage is
+        # closure-independent and stays put)
+        self._m4_full = m4_new.astype(bool)
+        self._m6_full = m6_new.astype(bool)
+        self._m4_any = (self._m4_full & self._max_dirty_roles).any(axis=1)
+        self._m6_any = (self._m6_full & self._max_dirty_roles).any(axis=1)
         self.idx = dataclasses.replace(idx, role_closure=h_new)
         return True
 
@@ -2309,40 +3042,12 @@ class RowPackedSaturationEngine:
         )
 
         def window_term(subt, rp_state, off, live, mask_rows, mm, lcw):
-            """One live L-window's contribution to a CR4/CR6 chunk: the
-            [rk, wlw] packed AND-OR product of the (factored-mask ∧
-            bit-table ∧ ``live``) operand against the window's R rows.
-            ``lcw`` is the rule's window length (CR4 may run finer
-            windows than CR6 — see ``lc4`` in ``__init__``).  ``live``
-            zeroes the operand when nothing the window reads changed
-            last step — OR-monotone, so skipping only delays; the Pallas
-            kernel's per-tile skip flags then drop the MXU work.  Shared
-            verbatim by the unrolled and scanned formulations
-            (tests/test_scan_engine.py pins them bit-identical).  Window
-            contents slice the SHARED filler/link-role tables (stacked
-            per-chunk copies would replicate them ×n_chunks in the run
-            arguments)."""
-            fcols = lax.dynamic_slice(fills, (off,), (lcw,))
-            lrole = lax.dynamic_slice(lroles, (off,), (lcw,))
-            with jax.named_scope("bit_table"):
-                if axis_name is None:
-                    f = bit_lookup_from(subt, fcols, dtype=dt)
-                else:
-                    f = lax.psum(
-                        bit_lookup_from(
-                            subt, fcols,
-                            word_offset=base, dtype=jnp.int32,
-                        ),
-                        axis_name,
-                    ).astype(dt)                          # [lc, rk]
-            # factored mask tile: mask[j, l] = mask_rows[j, role(l)]
-            w = (
-                jnp.take(mask_rows, lrole, axis=1).astype(dt)
-                * f.T
-                * live.astype(dt)
+            # the shared module-level formulation (also the sparse
+            # tier's), bound to this step's tables and shard context
+            return _window_term(
+                subt, rp_state, fills, lroles, off, live, mask_rows,
+                mm, lcw, dt, wlw, axis_name, base,
             )
-            b = lax.dynamic_slice(rp_state, (off, 0), (lcw, wlw))
-            return mm(w, b)
 
         def contract_from(
             bits_state, rp_state, rows, mask_rows, mm, f_dirty, tiles,
@@ -2694,22 +3399,7 @@ class RowPackedSaturationEngine:
         )
         return sp, rp, changed, bits, dirty
 
-    def saturate_observed(
-        self,
-        max_iters: int = 10_000,
-        *,
-        observer=None,
-        state_observer=None,
-        initial: Optional[Tuple[np.ndarray, np.ndarray]] = None,
-        allow_incomplete: bool = False,
-    ) -> SaturationResult:
-        """Fixed point with per-superstep observation — the observable
-        analog of the reference's progress plane (pub-sub gossip consumed
-        by ``worksteal/ProgressMessageHandler.java`` and the timed
-        completeness snapshots of ``misc/ResultSnapshotter.java``).  One
-        host sync per superstep, so use :meth:`saturate` for benchmarks.
-        On a mesh each superstep runs in the same shard_map structure as
-        the fixed point."""
+    def _ensure_observe_jit(self):
         if self._observe_jit is None:
             # old sp/rp are dead after each round — donate the buffers
             if self.mesh is None:
@@ -2747,6 +3437,164 @@ class RowPackedSaturationEngine:
                     return sp, rp, lanes.max(), bits, dirty
 
                 self._observe_jit = observe
+        return self._observe_jit
+
+    def _host_gate_flags(self, mask_s, any_r) -> np.ndarray:
+        """Host replication of :meth:`_next_dirty` — the controller
+        enters a dense round with a host-built carry after sparse
+        rounds, and the flags must match what the device fold would
+        have produced from the same masks."""
+        if self._gate is None:
+            return np.ones(1, bool)
+        flags = []
+        for kind, rows in self._gate["readers"]:
+            if kind == "SR":
+                d = any_r or (
+                    rows is not None
+                    and len(rows) > 0
+                    and bool(mask_s[rows].any())
+                )
+            elif kind == "RR":
+                d = any_r
+            else:  # CR5
+                d = any_r or bool(mask_s[BOTTOM_ID])
+            flags.append(d)
+        return np.asarray(flags, bool)
+
+    def _saturate_adaptive(
+        self, cfg, sp, rp, init_total, budget, observer, state_observer,
+        frontier_observer,
+    ):
+        """The dense/sparse controller loop (single device).  Per
+        round: fold the previous round's frontier on the host, measure
+        density, and pick the tier — dense (the regular ``unroll``-step
+        observed round) above ``density_threshold`` or on workspace
+        overflow; sparse (one frontier-compacted superstep) once
+        ``hysteresis_rounds`` consecutive rounds measured below it
+        (switching back is immediate).  The host carries the full
+        frontier (changed-S mask, per-L-chunk dirty flags, gate flags),
+        so the tiers interleave freely; sparse rounds return the fold
+        directly plus a live-bit delta, skipping the dense round's
+        full-state popcount sweep."""
+        from distel_tpu.runtime.instrumentation import FRONTIER_EVENTS
+
+        self._ensure_observe_jit()
+        n_flags = self._gate["n_flags"] if self._gate else 0
+        gate_flags = np.ones(max(n_flags, 1), bool)
+        s_chg = np.ones(self.nc, bool)
+        dirty_l = np.ones(self.n_lchunks, bool)
+        any_r = True
+        below = 0
+        iteration, total, converged = 0, init_total, False
+        self.frontier_rounds = []
+        while iteration < budget:
+            t0 = time.perf_counter()
+            prev_total = total
+            rows_touched, density, measure, over = self._sparse_round_plan(
+                cfg, s_chg, dirty_l, any_r
+            )
+            if density < cfg["density_threshold"]:
+                below += 1
+            else:
+                below = 0
+            want_sparse = (
+                iteration > 0 and below >= cfg["hysteresis_rounds"]
+            )
+            use_sparse = want_sparse and measure is not None
+            if rows_touched == 0:
+                # empty frontier: either tier's step derives nothing —
+                # emit the final no-change round without running one
+                iteration += 1
+                changed = False
+                tier = "idle"
+            elif use_sparse:
+                plan = self._sparse_round_args(measure, dirty_l)
+                exe = self._sparse_aot(*plan["key"])
+                sp, rp, ch_d, delta_d, ms_d, ar_d, dl_d = exe(
+                    sp, rp, self._sparse_args(plan)
+                )
+                ch, delta, s_chg, ar, dirty_l = jax.device_get(
+                    (ch_d, delta_d, ms_d, ar_d, dl_d)
+                )
+                changed = bool(ch)
+                any_r = bool(ar)
+                total += int(delta)
+                gate_flags = self._host_gate_flags(s_chg, any_r)
+                iteration += 1
+                tier = "sparse"
+            else:
+                dirty_dev = (
+                    jnp.asarray(gate_flags),
+                    jnp.asarray(dirty_l),
+                    jnp.asarray(s_chg),
+                )
+                sp, rp, ch_d, bits_d, dirty_d = self._observe_jit(
+                    sp, rp, dirty_dev, self._masks
+                )
+                ch, bits, (gf, dl_, ms_) = fetch_global(
+                    (ch_d, bits_d, dirty_d)
+                )
+                changed = bool(ch)
+                total = _host_bit_total(bits)
+                gate_flags = np.asarray(gf)
+                dirty_l = np.asarray(dl_)
+                s_chg = np.asarray(ms_)
+                any_r = bool(dirty_l.any())
+                iteration += self.unroll
+                tier = "dense"
+            st = FrontierStats(
+                iteration=iteration,
+                tier=tier,
+                density=float(density),
+                rows_touched=rows_touched,
+                total_rows=self._sp_total_rows,
+                derivations=total - prev_total,
+                overflow=bool(want_sparse and measure is None and over),
+                wall_s=time.perf_counter() - t0,
+            )
+            FRONTIER_EVENTS.record(st)
+            self.frontier_rounds.append(st)
+            if frontier_observer is not None:
+                frontier_observer(st)
+            if observer is not None:
+                observer(iteration, total - init_total, changed)
+            if state_observer is not None:
+                state_observer(
+                    iteration, total - init_total, changed, sp, rp
+                )
+            if not changed:
+                converged = True
+                break
+        return sp, rp, iteration, total, converged
+
+    def saturate_observed(
+        self,
+        max_iters: int = 10_000,
+        *,
+        observer=None,
+        state_observer=None,
+        initial: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        allow_incomplete: bool = False,
+        sparse_tail=None,
+        frontier_observer=None,
+    ) -> SaturationResult:
+        """Fixed point with per-superstep observation — the observable
+        analog of the reference's progress plane (pub-sub gossip consumed
+        by ``worksteal/ProgressMessageHandler.java`` and the timed
+        completeness snapshots of ``misc/ResultSnapshotter.java``).  One
+        host sync per superstep, so use :meth:`saturate` for benchmarks.
+        On a mesh each superstep runs in the same shard_map structure as
+        the fixed point.
+
+        ``sparse_tail``: per-call override of the engine's adaptive
+        sparse-tail config (see ``__init__``); when active (and the
+        engine supports the tier) the adaptive controller replaces the
+        plain observed loop — low-density rounds run the
+        frontier-compacted step program and per-round
+        :class:`~distel_tpu.runtime.instrumentation.FrontierStats`
+        land in ``self.frontier_rounds`` (and ``frontier_observer``,
+        when given)."""
+        self._ensure_observe_jit()
         if initial is None:
             sp, rp = self.initial_state()
         else:
@@ -2759,19 +3607,31 @@ class RowPackedSaturationEngine:
             fetch_global(self._live_bits_jit(sp, rp))
         )
         budget = _pad_up(max_iters, self.unroll)
-        dirty_box = [self.initial_dirty()]
-
-        def observe_step(s, r):
-            s, r, ch, bits, dirty_box[0] = self._observe_jit(
-                s, r, dirty_box[0], self._masks
-            )
-            return s, r, ch, bits
-
-        sp, rp, iteration, total, converged = observed_loop(
-            observe_step,
-            sp, rp, init_total, self.unroll, budget, observer,
-            state_observer=state_observer,
+        cfg = (
+            self._sparse_cfg
+            if sparse_tail is None
+            else self._normalize_sparse_cfg(sparse_tail)
         )
+        if cfg is not None and self._sparse_supported():
+            sp, rp, iteration, total, converged = self._saturate_adaptive(
+                cfg, sp, rp, init_total, budget, observer,
+                state_observer, frontier_observer,
+            )
+        else:
+            self.frontier_rounds = []
+            dirty_box = [self.initial_dirty()]
+
+            def observe_step(s, r):
+                s, r, ch, bits, dirty_box[0] = self._observe_jit(
+                    s, r, dirty_box[0], self._masks
+                )
+                return s, r, ch, bits
+
+            sp, rp, iteration, total, converged = observed_loop(
+                observe_step,
+                sp, rp, init_total, self.unroll, budget, observer,
+                state_observer=state_observer,
+            )
         if not converged and not allow_incomplete:
             raise RuntimeError(
                 f"saturation did not converge within {budget} iterations"

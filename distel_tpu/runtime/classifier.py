@@ -112,6 +112,12 @@ def make_engine(
         # fast path's base-interop engines) construct directly
         rowpacked_kw.setdefault("bucket", config.shape_buckets)
         rowpacked_kw.setdefault("bucket_ratio", config.bucket_ratio)
+        # adaptive sparse-tail controller for observed runs: low-density
+        # rounds run the frontier-compacted step instead of the dense
+        # sweep (single-device; the engine ignores it otherwise)
+        rowpacked_kw.setdefault(
+            "sparse_tail", config.sparse_tail_config()
+        )
         return RowPackedSaturationEngine(idx, **kw, **rowpacked_kw)
     if choice == "packed":
         from distel_tpu.core.packed_engine import PackedSaturationEngine

@@ -134,6 +134,92 @@ class CompileStats:
         return self
 
 
+@dataclass
+class FrontierStats:
+    """One saturation round's frontier record — the telemetry the
+    adaptive sparse-tail controller (``RowPackedSaturationEngine.
+    saturate_observed``) is steered by and reports.  ``rows_touched``
+    is the number of rule-table rows the round actually had to
+    re-evaluate (row granularity throughout: CR1-CR3 on the changed-S
+    mask + intra-step cascade, CR4/CR6 on changed bit-table sources
+    and dirty-L-chunk role coverage); ``density`` is that count over
+    the total rule-table rows, the signal the dense/sparse tier
+    decision thresholds on.  ``tier`` records what actually ran
+    ("dense" | "sparse", or "idle" for the empty-frontier termination
+    round, where NO step program runs — idle rounds count toward
+    neither tier total); ``overflow`` marks a round whose active set
+    exceeded the largest sparse workspace rung, forcing the dense
+    fallback.
+    Threaded through ``bench.py`` / ``scripts/scale_probe.py`` round
+    records and the serve plane's ``/metrics`` gauges (via
+    :data:`FRONTIER_EVENTS`)."""
+
+    iteration: int = 0
+    tier: str = "dense"
+    density: float = 1.0
+    rows_touched: int = 0
+    total_rows: int = 0
+    derivations: int = 0
+    overflow: bool = False
+    wall_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "tier": self.tier,
+            "density": round(self.density, 5),
+            "rows_touched": self.rows_touched,
+            "total_rows": self.total_rows,
+            "derivations": self.derivations,
+            "overflow": self.overflow,
+            "wall_s": round(self.wall_s, 4),
+        }
+
+
+class FrontierAggregate:
+    """Process-global tally of sparse-tail controller rounds — the
+    bridge from per-run :class:`FrontierStats` to a resident service's
+    gauges (``serve/server.py`` registers ``distel_frontier_*`` from
+    :data:`FRONTIER_EVENTS`).  Thread-safe: concurrent classify calls
+    may each run a controller."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self.dense_rounds = 0
+        self.sparse_rounds = 0
+        self.overflow_rounds = 0
+        self.last_density = 1.0
+        self.last_rows_touched = 0
+
+    def record(self, st: "FrontierStats") -> None:
+        with self._lock:
+            if st.tier == "sparse":
+                self.sparse_rounds += 1
+            elif st.tier == "dense":
+                self.dense_rounds += 1
+            # "idle" (empty-frontier termination, no program ran)
+            # counts toward neither tier
+            if st.overflow:
+                self.overflow_rounds += 1
+            self.last_density = st.density
+            self.last_rows_touched = st.rows_touched
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dense_rounds": self.dense_rounds,
+                "sparse_rounds": self.sparse_rounds,
+                "overflow_rounds": self.overflow_rounds,
+                "last_density": self.last_density,
+                "last_rows_touched": self.last_rows_touched,
+            }
+
+
+FRONTIER_EVENTS = FrontierAggregate()
+
+
 class _PersistentCacheCounter:
     """Process-global tally of jax's persistent-compilation-cache events
     (``/jax/compilation_cache/cache_hits`` / ``cache_misses``).  jax's

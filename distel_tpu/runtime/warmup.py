@@ -82,12 +82,16 @@ def warmup_text(
         raise ValueError(
             f"unknown warmup profile {profile!r}: 'serve' or 'classify'"
         )
+    # the default roster now includes the sparse-tail tier's floor-rung
+    # program (when the config enables the tier): a warmed bucket
+    # serves its first low-density tail round compile-free too
     stats = engine.precompile(max_iters or config.max_iterations)
     return {
         "profile": profile,
         "concepts": idx.n_concepts,
         "links": idx.n_links,
         "wall_s": round(time.monotonic() - t0, 3),
+        "sparse_programs": len(getattr(engine, "_sparse_builds", ())),
         **stats.as_dict(),
     }
 

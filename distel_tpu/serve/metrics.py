@@ -55,6 +55,8 @@ class Metrics:
         self._gauges: Dict[str, Union[float, Callable[[], float]]] = {}
         #: name → (buckets, {labels_key → [bucket_counts, sum, count]})
         self._hists: Dict[str, tuple] = {}
+        #: callables returning {name → value}, one call per render pass
+        self._gauge_groups: list = []
         self._help: Dict[str, str] = {}
 
     # ------------------------------------------------------------ write
@@ -88,6 +90,15 @@ class Metrics:
         render time, so the scrape always sees the current value."""
         with self._lock:
             self._gauges[name] = fn
+
+    def gauge_group(self, fn: Callable[[], Dict[str, float]]) -> None:
+        """Register a group of live-sampled gauges: ``fn`` returns a
+        ``{name: value}`` dict and is called ONCE per render pass, so
+        every gauge in the group is derived from the same sample —
+        mutually consistent within one scrape even under concurrent
+        scrapes (each pass gets its own call)."""
+        with self._lock:
+            self._gauge_groups.append(fn)
 
     def observe(
         self,
@@ -123,12 +134,19 @@ class Metrics:
             counters = {
                 n: dict(s) for n, s in sorted(self._counters.items())
             }
-            gauges = dict(sorted(self._gauges.items()))
+            gauges = dict(self._gauges)
+            groups = list(self._gauge_groups)
             hists = {
                 n: (b, {k: (list(c), s, cnt) for k, (c, s, cnt) in se.items()})
                 for n, (b, se) in sorted(self._hists.items())
             }
             helps = dict(self._help)
+        for fn in groups:
+            try:
+                gauges.update(fn())
+            except Exception:  # a dying group must not kill /metrics
+                continue
+        gauges = dict(sorted(gauges.items()))
         lines = []
         for name, series in counters.items():
             if name in helps:

@@ -154,6 +154,53 @@ class ServeApp:
             "distel_warmup_programs_total",
             "bucket programs precompiled by the startup warmup",
         )
+        # ---- adaptive sparse-tail frontier telemetry: live-sampled
+        # from the process-global controller aggregate
+        # (runtime/instrumentation.FRONTIER_EVENTS) — per-round tier
+        # decisions, last observed frontier density, overflow fallbacks
+        from distel_tpu.runtime.instrumentation import FRONTIER_EVENTS
+
+        # NB: deliberately no Prometheus `_total` suffix — these are
+        # live-sampled from the process-global aggregate and exported
+        # through the gauge path; `_total` is reserved for counters and
+        # trips promtool lint / rate() semantics on a gauge
+        _FRONTIER_GAUGES = (
+            (
+                "distel_frontier_dense_rounds",
+                "dense_rounds",
+                "observed saturation rounds run on the dense step",
+            ),
+            (
+                "distel_frontier_sparse_rounds",
+                "sparse_rounds",
+                "observed saturation rounds run on the sparse tier",
+            ),
+            (
+                "distel_frontier_overflow_rounds",
+                "overflow_rounds",
+                "sparse-eligible rounds forced dense by workspace overflow",
+            ),
+            (
+                "distel_frontier_density",
+                "last_density",
+                "frontier density of the last observed saturation round",
+            ),
+            (
+                "distel_frontier_rows_touched",
+                "last_rows_touched",
+                "active rule rows of the last observed saturation round",
+            ),
+        )
+
+        def _frontier_gauges():
+            # one snapshot per render pass keeps the five gauges
+            # mutually consistent within a scrape
+            snap = FRONTIER_EVENTS.snapshot()
+            return {m: snap[k] for m, k, _ in _FRONTIER_GAUGES}
+
+        for metric, _, help_text in _FRONTIER_GAUGES:
+            self.metrics.describe(metric, help_text)
+        self.metrics.gauge_group(_frontier_gauges)
         # ---- background warmup precompile: populate the program
         # registry / persistent cache for the configured buckets BEFORE
         # traffic arrives; a failure only leaves the caches cold (the

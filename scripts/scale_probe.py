@@ -95,6 +95,15 @@ def _parse_args(argv=None):
                          "because EL+ saturation is monotone — and the "
                          "record reports resumed + total derivation "
                          "accounting")
+    ap.add_argument("--no-sparse-tail", dest="sparse_tail",
+                    action="store_false", default=True,
+                    help="disable the adaptive sparse-tail controller "
+                         "on observed --execute runs (single-device "
+                         "only; mesh runs are dense regardless — the "
+                         "sharded sparse tier is a ROADMAP open item). "
+                         "When active, per-round progress lines carry "
+                         "tier/density/rows_touched and the record "
+                         "gains a sparse_tail summary")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.resume_from and not args.execute:
@@ -171,8 +180,48 @@ def run_probe(args) -> None:
         devices = np.array(jax.devices()[: args.devices])
         mesh = jax.sharding.Mesh(devices, ("c",))
     t0 = time.time()
-    engine = RowPackedSaturationEngine(idx, mesh=mesh)
+    # progress/snapshot paths resolve BEFORE engine construction: the
+    # sparse tier only engages in the observed fixed-point loop, so a
+    # non-observed --execute run must neither claim it nor have scan
+    # mode forced on for it (that would shift exec_wall_s vs probe
+    # history for a feature that never ran)
+    progress = args.progress_file or (
+        args.out + ".progress" if args.out else None
+    )
+    snap_path = args.snapshot or (
+        args.out + ".snapshot.npz" if args.out else None
+    )
+    snap_every = (
+        args.snapshot_every
+        if args.snapshot_every is not None
+        else (5 if snap_path else 0)
+    )
+    want_snap = bool(snap_path) and snap_every > 0
+    if args.execute and args.snapshot_every and snap_path is None:
+        # fail at LAUNCH, not hours in (before the engine build and AOT
+        # compile probe): an explicit --snapshot-every with no
+        # resolvable path would otherwise be a silent no-op
+        raise SystemExit(
+            "--snapshot-every needs a snapshot path: pass --snapshot "
+            "or --out"
+        )
+    will_observe = bool(args.execute and (progress or want_snap))
+    # the sparse tier rides the scanned CR4/CR6 formulation (pinned
+    # bit-identical to the unrolled one by tests/test_scan_engine.py);
+    # at SNOMED scale scan mode auto-engages anyway, so forcing it here
+    # only affects small probes that asked for the sparse tail
+    want_sparse = bool(
+        args.sparse_tail and args.devices == 0 and will_observe
+    )
+    engine = RowPackedSaturationEngine(
+        idx, mesh=mesh,
+        sparse_tail=(True if want_sparse else None),
+        scan_chunks=(True if want_sparse else None),
+    )
     rec["build_s"] = round(time.time() - t0, 1)
+    rec["sparse_tail_enabled"] = bool(
+        want_sparse and engine._sparse_supported()
+    )
     # resolved program identity + (later) the compile-vs-execute wall
     # split: announced at LAUNCH so a killed multi-hour run still
     # records which bucket/program it was paying for
@@ -182,6 +231,7 @@ def run_probe(args) -> None:
             {
                 "bucket_signature": engine.bucket_signature,
                 "build_s": rec["build_s"],
+                "sparse_tail": rec["sparse_tail_enabled"],
             }
         ),
         flush=True,
@@ -233,24 +283,6 @@ def run_probe(args) -> None:
         del compiled, lowered
 
     if args.execute:
-        progress = args.progress_file or (
-            args.out + ".progress" if args.out else None
-        )
-        snap_path = args.snapshot or (
-            args.out + ".snapshot.npz" if args.out else None
-        )
-        if args.snapshot_every and snap_path is None:
-            # fail at LAUNCH, not hours in: an explicit --snapshot-every
-            # with no resolvable path would otherwise be a silent no-op
-            raise SystemExit(
-                "--snapshot-every needs a snapshot path: pass --snapshot "
-                "or --out"
-            )
-        snap_every = (
-            args.snapshot_every
-            if args.snapshot_every is not None
-            else (5 if snap_path else 0)
-        )
         if snap_path and snap_every > 0:
             # announce the disk cost at LAUNCH, not hours in: the
             # uncompressed snapshot is the packed S/R wire state
@@ -281,7 +313,6 @@ def run_probe(args) -> None:
                 "derivations": base_derivs,
                 "load_s": round(time.time() - t0, 1),
             }
-        want_snap = bool(snap_path) and snap_every > 0
         t0 = time.time()
         if progress or want_snap:
             # observed fixed point: one host sync per superstep round
@@ -295,6 +326,15 @@ def run_probe(args) -> None:
             # for a pure-execution figure
             first_round = []
             observer = None
+            # per-round frontier stats from the adaptive controller
+            # (tier chosen, density, rows touched) — merged into the
+            # progress lines so a probe record shows WHICH rounds ran
+            # the sparse tier and what the frontier looked like
+            frontier_box = [None]
+
+            def frontier_observer(st):
+                frontier_box[0] = st
+
             if progress:
                 with open(progress, "a") as f:
                     f.write(json.dumps({
@@ -305,13 +345,19 @@ def run_probe(args) -> None:
                 def observer(iteration, derivations, changed):
                     if not first_round:
                         first_round.append(round(time.time() - t0, 1))
+                    line = {
+                        "iteration": int(iteration),
+                        "derivations": int(derivations),
+                        "changed": bool(changed),
+                        "wall_s": round(time.time() - t0, 1),
+                    }
+                    st = frontier_box[0]
+                    if st is not None and st.iteration == iteration:
+                        line["tier"] = st.tier
+                        line["density"] = round(st.density, 5)
+                        line["rows_touched"] = st.rows_touched
                     with open(progress, "a") as f:
-                        f.write(json.dumps({
-                            "iteration": int(iteration),
-                            "derivations": int(derivations),
-                            "changed": bool(changed),
-                            "wall_s": round(time.time() - t0, 1),
-                        }) + "\n")
+                        f.write(json.dumps(line) + "\n")
 
             state_observer = None
             if want_snap:
@@ -377,6 +423,7 @@ def run_probe(args) -> None:
                 observer=observer,
                 state_observer=state_observer,
                 initial=snap_state,
+                frontier_observer=frontier_observer,
             )
             rec["observed_mode"] = True
             if first_round:
@@ -384,6 +431,26 @@ def run_probe(args) -> None:
                 # AOT step_compile_s above measured the (unexecuted)
                 # while-loop program
                 rec["first_round_wall_s"] = first_round[0]
+            if engine.frontier_rounds:
+                frs = engine.frontier_rounds
+                rec["sparse_tail"] = {
+                    "sparse_rounds": sum(
+                        1 for s in frs if s.tier == "sparse"
+                    ),
+                    "dense_rounds": sum(
+                        1 for s in frs if s.tier == "dense"
+                    ),
+                    "overflow_rounds": sum(1 for s in frs if s.overflow),
+                    # the terminal empty-frontier round is always 0.0 —
+                    # excluded so the stat reflects the working minimum
+                    # (what sparse_tail.density_threshold tunes against)
+                    "min_density": round(
+                        min(
+                            (s.density for s in frs if s.tier != "idle"),
+                            default=0.0,
+                        ), 5
+                    ),
+                }
         else:
             result = engine.saturate(initial=snap_state)
         rec["exec_wall_s"] = round(time.time() - t0, 1)

@@ -1,0 +1,64 @@
+"""bench.py backend-acquisition robustness (ISSUE 4 satellite): the r5
+official bench burned 5×60 s serial retries on a black-holed tunnel.
+The policy is now env-configurable, records per-attempt elapsed time,
+and fails fast on the second identical consecutive timeout."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+import bench  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_backoff(monkeypatch):
+    monkeypatch.setenv("DISTEL_BENCH_BACKEND_BACKOFF_S", "0")
+
+
+def test_fail_fast_on_second_identical_timeout(monkeypatch):
+    calls = []
+
+    def hang(*a, **kw):
+        calls.append(1)
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    import subprocess as sp
+
+    monkeypatch.setattr(sp, "run", hang)
+    with pytest.raises(TimeoutError):
+        bench._acquire_backend(attempts=5)
+    # two identical hangs, then fail fast — not five serial walls
+    assert len(calls) == 2
+    assert len(bench._ATTEMPT_LOG) == 2
+    assert all("elapsed_s" in rec for rec in bench._ATTEMPT_LOG)
+
+
+def test_attempts_env_knob_and_attempt_log(monkeypatch):
+    monkeypatch.setenv("DISTEL_BENCH_BACKEND_ATTEMPTS", "3")
+    calls = []
+
+    def flaky(*a, **kw):
+        calls.append(1)
+        raise RuntimeError(f"tunnel UNAVAILABLE #{len(calls)}")
+
+    import subprocess as sp
+
+    monkeypatch.setattr(sp, "run", flaky)
+    with pytest.raises(RuntimeError):
+        bench._acquire_backend()
+    # distinct transient errors retry to the (env-configured) limit
+    assert len(calls) == 3
+    assert [r["attempt"] for r in bench._ATTEMPT_LOG] == [1, 2, 3]
+
+
+def test_failure_record_carries_attempt_log(monkeypatch, capsys):
+    bench._ATTEMPT_LOG[:] = [
+        {"attempt": 1, "error": "TimeoutError: hung", "elapsed_s": 180.0}
+    ]
+    bench._emit_failure("backend_init", TimeoutError("hung"), 1)
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["failed_stage"] == "backend_init"
+    assert rec["attempt_log"][0]["elapsed_s"] == 180.0

@@ -1,0 +1,239 @@
+"""Adaptive sparse-tail execution (ISSUE 4): the frontier-compacted
+step program + dense/sparse controller.
+
+The soundness claim under test: an adaptive run (controller switching
+low-density rounds onto the sparse step) is BYTE-IDENTICAL per round to
+a dense-only run — same per-round derivation counts, same final S/R
+closures — because the sparse tier's active-set selection replicates
+the dense step's gating semantics exactly, and rows it skips provably
+contribute nothing new under monotone OR.  Plus the tier's ops
+properties: workspace overflow falls back to the dense step for the
+round (never drops work), and same-capacity sparse programs of
+same-bucket ontologies share one executable through the program
+registry, like the dense programs."""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import synthetic_ontology
+from distel_tpu.owl import parser
+
+from test_bucketing import _same_bucket_pair
+
+
+def _indexed(text):
+    return index_ontology(normalize(parser.parse(text)))
+
+
+@pytest.fixture(scope="module")
+def galen_idx():
+    """GALEN-shape corpus with a subclass-chain tail appended — late
+    rounds derive one chain hop each, the regime the sparse tier is
+    for."""
+    n = 400
+    text = synthetic_ontology(
+        n_classes=n, n_anatomy=n // 10, n_locations=n // 12,
+        n_definitions=n // 20,
+    )
+    text += "\n" + "\n".join(
+        f"SubClassOf(TailChain{i} TailChain{i + 1})" for i in range(12)
+    )
+    text += "\nSubClassOf(Class0 TailChain0)"
+    return _indexed(text)
+
+
+def _observed(idx, sparse, **kw):
+    engine = RowPackedSaturationEngine(idx, unroll=1, bucket=True, **kw)
+    rounds = []
+    res = engine.saturate_observed(
+        observer=lambda it, d, ch: rounds.append((it, d, ch)),
+        sparse_tail=sparse,
+    )
+    return engine, rounds, res
+
+
+def _assert_same_closure(res_a, res_b):
+    assert np.array_equal(
+        np.asarray(res_a.packed_s), np.asarray(res_b.packed_s)
+    )
+    assert np.array_equal(
+        np.asarray(res_a.packed_r), np.asarray(res_b.packed_r)
+    )
+
+
+# --------------------------------------------- per-round golden parity
+
+
+def test_adaptive_matches_dense_per_round(galen_idx):
+    """THE parity fixture: dense-only vs adaptive observed runs on the
+    Galen shape produce identical per-round (iteration, derivations,
+    changed) sequences AND byte-identical final closures — with the
+    threshold forced high so every post-warmup round runs sparse (the
+    strictest exercise of the selection logic)."""
+    _, dense_rounds, res_d = _observed(galen_idx, {"enable": False})
+    eng, ad_rounds, res_a = _observed(
+        galen_idx, {"density_threshold": 1.1, "hysteresis_rounds": 1}
+    )
+    assert ad_rounds == dense_rounds
+    _assert_same_closure(res_d, res_a)
+    tiers = [s.tier for s in eng.frontier_rounds]
+    assert tiers[0] == "dense"  # all-dirty first round
+    assert tiers.count("sparse") >= 3
+    # telemetry coherence: round records cover every observed round,
+    # densities fall off monotonically to the empty-frontier finish
+    assert len(eng.frontier_rounds) == len(ad_rounds)
+    assert eng.frontier_rounds[-1].rows_touched == 0
+    assert eng.frontier_rounds[-1].density == 0.0
+
+
+def test_adaptive_default_threshold_runs_sparse_tail(galen_idx):
+    """With the DEFAULT controller config the chain tail's low-density
+    rounds go sparse (hysteresis honored) and the closure still
+    matches dense-only."""
+    _, dense_rounds, res_d = _observed(galen_idx, {"enable": False})
+    eng, ad_rounds, res_a = _observed(galen_idx, True)
+    assert ad_rounds == dense_rounds
+    _assert_same_closure(res_d, res_a)
+    sts = eng.frontier_rounds
+    assert any(s.tier == "sparse" for s in sts)
+    # hysteresis: the first below-threshold round stays dense
+    thr = RowPackedSaturationEngine._SPARSE_DEFAULTS["density_threshold"]
+    first_below = next(i for i, s in enumerate(sts) if s.density < thr)
+    assert sts[first_below].tier == "dense"
+
+
+# -------------------------------------- overflow -> dense fallback
+
+
+def test_capacity_overflow_falls_back_dense(galen_idx):
+    """A one-rung roster with a tiny floor overflows on the busy
+    rounds: those run dense (flagged overflow), the tail still runs
+    sparse, and the closure is unchanged — overflow delays the tier,
+    never drops work."""
+    _, dense_rounds, res_d = _observed(galen_idx, {"enable": False})
+    eng, ad_rounds, res_a = _observed(
+        galen_idx,
+        {
+            "density_threshold": 1.1,
+            "hysteresis_rounds": 1,
+            "capacity_buckets": 1,
+            "capacity_floor": 8,
+        },
+    )
+    assert ad_rounds == dense_rounds
+    _assert_same_closure(res_d, res_a)
+    sts = eng.frontier_rounds
+    assert any(s.overflow and s.tier == "dense" for s in sts)
+    assert any(s.tier == "sparse" for s in sts)
+
+
+# ------------------------------------- program sharing across buckets
+
+
+def test_same_bucket_sparse_programs_share_executable():
+    """Two same-bucket DIFFERENT ontologies: the second engine's
+    sparse-step builds are in-process registry hits for every capacity
+    rung the first one compiled — the cold-start story of the dense
+    programs, extended to the sparse roster."""
+    text_a, text_b = _same_bucket_pair()
+    idx_a, idx_b = _indexed(text_a), _indexed(text_b)
+    cfg = {"density_threshold": 1.1, "hysteresis_rounds": 1}
+    eng_a, _, _ = _observed(idx_a, cfg)
+    eng_b, _, _ = _observed(idx_b, cfg)
+    assert eng_a.bucket_signature == eng_b.bucket_signature
+    assert eng_a._sparse_builds, "run A compiled no sparse programs"
+    keys_a = set(eng_a._aot_sparse)
+    hits_b = {
+        tuple(
+            int(x) for x in
+            st.program[len("sparse["):-1].split(",")
+        ): st.program_cache_hit
+        for st in eng_b._sparse_builds
+    }
+    shared = [k for k in hits_b if k in keys_a]
+    assert shared, (keys_a, hits_b)
+    assert all(hits_b[k] for k in shared), hits_b
+
+
+def test_sparse_precompile_warms_floor_rung(galen_idx):
+    """precompile()'s default roster includes the sparse tier's
+    floor-rung program; a second same-bucket engine then gets it as a
+    registry hit."""
+    eng = RowPackedSaturationEngine(
+        galen_idx, unroll=1, bucket=True, sparse_tail=True
+    )
+    eng.precompile(programs=("sparse",))
+    floor = eng._sparse_cfg["capacity_floor"]
+    key = (
+        floor,
+        floor if eng._scan4 else 0,
+        floor if eng._scan6 else 0,
+    )
+    assert key in eng._aot_sparse
+    eng2 = RowPackedSaturationEngine(
+        galen_idx, unroll=1, bucket=True, sparse_tail=True
+    )
+    eng2._sparse_aot(*key)
+    assert eng2._sparse_builds[-1].program_cache_hit
+
+
+# ------------------------- rebind_role_closure dropped-span regression
+
+
+_ALL_DROPPED_BASE = (
+    # the only links ride role q; the only ∃-on-the-left axiom needs r,
+    # which no link can satisfy -> its whole scanned span is dropped
+    "SubClassOf(A ObjectSomeValuesFrom(q B))\n"
+    "SubClassOf(C ObjectSomeValuesFrom(q B))\n"
+    "SubClassOf(ObjectSomeValuesFrom(r B) RHit)\n"
+    "SubClassOf(A A2)\n"
+)
+
+
+def test_rebind_consumes_persisted_dropped_spans():
+    """An all-dropped CR4 table persists its span grid at build;
+    rebind under a closure that revives a dropped span must refuse
+    (the compiled program lacks the structure)."""
+    idx_old = _indexed(_ALL_DROPPED_BASE)
+    idx_new = _indexed(_ALL_DROPPED_BASE + "SubObjectPropertyOf(q r)\n")
+    assert idx_old.n_roles == idx_new.n_roles
+    eng = RowPackedSaturationEngine(idx_old, scan_chunks=True)
+    assert eng._scan_mode
+    assert eng._scan4 is None  # every span dead at build
+    assert eng._scan4_dropped, "build must persist the dropped spans"
+    eng.saturate()
+    assert not eng.rebind_role_closure(idx_new.role_closure)
+
+
+def test_degenerate_sparse_cfg_rejected_at_build(galen_idx):
+    """capacity_buckets/capacity_floor < 1 or hysteresis_rounds < 1
+    must be rejected at engine construction — capacity_buckets=0 used
+    to surface rounds deep into saturate_observed as a negative-shift
+    ValueError from _sparse_rung, and hysteresis_rounds=0 made the
+    controller ignore the density threshold entirely (below >= 0 is
+    always true from round 2 on)."""
+    for bad in (
+        {"capacity_buckets": 0},
+        {"capacity_floor": 0},
+        {"hysteresis_rounds": 0},
+    ):
+        with pytest.raises(ValueError, match="sparse_tail"):
+            RowPackedSaturationEngine(
+                galen_idx, unroll=1, bucket=True, sparse_tail=bad
+            )
+
+
+def test_rebind_dropped_spans_survive_scan_rk_desync():
+    """The desync tripwire: rebind must consult the spans PERSISTED by
+    build_scan, not re-derive boundaries from self._scan_rk — corrupt
+    the latter and the refusal must still come out right (re-deriving
+    would divide by a zero chunk size here)."""
+    idx_old = _indexed(_ALL_DROPPED_BASE)
+    idx_new = _indexed(_ALL_DROPPED_BASE + "SubObjectPropertyOf(q r)\n")
+    eng = RowPackedSaturationEngine(idx_old, scan_chunks=True)
+    assert eng._scan4 is None
+    eng._scan_rk = (0, 0)  # a desynced grid re-derivation would crash
+    assert not eng.rebind_role_closure(idx_new.role_closure)
