@@ -226,8 +226,43 @@ def _acquire_backend(attempts=None, backoff_s=None):
     raise last
 
 
-def _emit_failure(stage: str, err: BaseException, attempts: int) -> None:
-    """One parseable JSON line instead of a traceback (r4 weak #1)."""
+#: stdout marker the bench child prints after each completed section —
+#: the parent harvests these on failure so an r05-style backend
+#: black-hole mid-run no longer discards everything already measured
+_PARTIAL_PREFIX = "#partial "
+
+
+def _partial(**fields) -> None:
+    """Checkpoint already-measured results from the bench child: one
+    ``#partial {json}`` stdout line per completed section.  ``#``-lines
+    are invisible to the parent's result scan (it only accepts lines
+    starting with ``{``), but on a failure the parent folds every
+    partial seen into the failure record's ``partial_results``."""
+    print(_PARTIAL_PREFIX + json.dumps(fields), flush=True)
+
+
+def _collect_partials(stdout) -> dict:
+    """Merge the ``#partial`` checkpoints out of a dead child's
+    captured stdout (later sections win on key collisions)."""
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    merged: dict = {}
+    for ln in (stdout or "").splitlines():
+        if ln.startswith(_PARTIAL_PREFIX):
+            try:
+                merged.update(json.loads(ln[len(_PARTIAL_PREFIX):]))
+            except ValueError:
+                pass  # a truncated partial line must not mask the error
+    return merged
+
+
+def _emit_failure(
+    stage: str, err: BaseException, attempts: int, partial=None
+) -> None:
+    """One parseable JSON line instead of a traceback (r4 weak #1).
+    ``partial``: sections the bench body completed before dying (the
+    ``#partial`` checkpoints harvested from the child's stdout) — a
+    failure after saturation keeps its measured numbers."""
     print(
         json.dumps(
             {
@@ -242,6 +277,7 @@ def _emit_failure(stage: str, err: BaseException, attempts: int) -> None:
                 "attempt_log": list(_ATTEMPT_LOG),
                 "load1": _load1(),
                 "last_known_good": _LAST_KNOWN_GOOD,
+                **({"partial_results": partial} if partial else {}),
             }
         )
     )
@@ -300,6 +336,7 @@ def main() -> None:
                     f"partial stdout: {partial[-200:]!r}"
                 ),
                 attempt + 1,
+                partial=_collect_partials(partial),
             )
             return
         sys.stderr.write(p.stderr or "")
@@ -318,7 +355,10 @@ def main() -> None:
             f"bench child rc={p.returncode}: {(p.stderr or '')[-400:]}"
         )
         if not _is_transient(last):
-            _emit_failure("bench_body", last, attempt + 1)
+            _emit_failure(
+                "bench_body", last, attempt + 1,
+                partial=_collect_partials(p.stdout),
+            )
             return
         if attempt == 0:  # no backoff after the final attempt
             print(
@@ -331,7 +371,9 @@ def main() -> None:
                 _acquire_backend(attempts=3)
             except Exception:  # noqa: BLE001 — recorded by final emit
                 pass
-    _emit_failure("bench_body", last, 2)
+    _emit_failure(
+        "bench_body", last, 2, partial=_collect_partials(p.stdout)
+    )
 
 
 def _sparse_tail_probe(n_classes: int = 4000, chain_depth: int = 28) -> dict:
@@ -366,11 +408,18 @@ def _sparse_tail_probe(n_classes: int = 4000, chain_depth: int = 28) -> dict:
         res = engine.saturate_observed(observer=obs, sparse_tail=sparse)
         return dict(walls), res
 
-    e_dense = RowPackedSaturationEngine(idx, bucket=True, unroll=1)
+    # pipeline off: this probe times rounds via observer inter-arrival,
+    # which only equals per-round wall when observers fire at the
+    # synchronous decision point (pipelined runs fire them at deferred
+    # retire time — the dense/sparse wall ratio would be skewed by
+    # whatever host work the overlap hid)
+    e_dense = RowPackedSaturationEngine(idx, bucket=True, unroll=1,
+                                        pipeline={"enable": False})
     observed(e_dense, {"enable": False})  # warm programs
     dense_walls, res_dense = observed(e_dense, {"enable": False})
     e_ad = RowPackedSaturationEngine(idx, bucket=True, unroll=1,
-                                     sparse_tail=True)
+                                     sparse_tail=True,
+                                     pipeline={"enable": False})
     observed(e_ad, None)  # warm (incl. the sparse rung programs)
     ad_walls, res_ad = observed(e_ad, None)
 
@@ -428,6 +477,180 @@ def _sparse_tail_probe(n_classes: int = 4000, chain_depth: int = 28) -> dict:
     }
 
 
+def _pipeline_probe(n_classes: int = 2000, chain_depth: int = 24) -> dict:
+    """Pipelined vs synchronous observed saturation (ISSUE 5) on a
+    chain-tailed GALEN-shape corpus, sparse tail off so every round is
+    dense and the observed walls ARE the dense phase.  Three
+    measurements, all PAIRED (sync and pipelined runs interleaved and
+    compared per pair — this box's wall clock drifts ~2x with outside
+    load, so unpaired medians are noise):
+
+    * raw walls (``saturate()`` vs observed at depths 1/2/4): the
+      pipelined loop must cost ~nothing when there is nothing to hide;
+    * an I/O-observer A/B — per-round observer wait calibrated to
+      ~0.7x a round's execution, modelling the serving plane's
+      progress writes / metrics push / pub-sub gossip (the reference's
+      per-iteration Redis barrier, and exactly what ``scale_probe
+      --progress`` pays per round).  I/O waits overlap cleanly, so
+      this shows the pipeline's full win: sync pays the wait serially
+      between rounds, pipelined retires it while the executor runs the
+      next round;
+    * a CPU-observer A/B (numpy fold of the same calibrated size):
+      on this 2-core rig a compute-bound observer contends with XLA
+      execution for cores and memory bandwidth, so the speedup is
+      bounded well below the I/O case — recorded as the honest floor
+      (a real accelerator executes off-host and has no such cap).
+
+    Plus the per-round host-time split and an inline-dispatch
+    microprobe showing why dispatch goes through the executor on this
+    backend."""
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from distel_tpu.frontend.ontology_tools import synthetic_ontology as synth
+
+    text = synth(
+        n_classes=n_classes, n_anatomy=n_classes // 10,
+        n_locations=n_classes // 12, n_definitions=n_classes // 20,
+    )
+    text += "\n" + "\n".join(
+        f"SubClassOf(TailChain{i} TailChain{i + 1})"
+        for i in range(chain_depth)
+    )
+    idx = index_ontology(normalize(parser.parse(text + "\nSubClassOf(Class0 TailChain0)")))
+    engine = RowPackedSaturationEngine(idx, bucket=True, unroll=1)
+    engine.saturate()  # warm the fused program
+
+    def observed(depth, observer=None):
+        t0 = time.time()
+        res = engine.saturate_observed(
+            observer=observer,
+            sparse_tail={"enable": False},
+            pipeline={"enable": True, "depth": depth},
+        )
+        return time.time() - t0, res
+
+    observed(1)
+    observed(2)  # warm both loop paths
+    walls = {1: [], 2: [], 4: []}
+    sat_walls = []
+    for _ in range(5):  # interleaved so outside load drifts cancel
+        sat_walls.append(_timed(engine.saturate))
+        for depth in (1, 2, 4):
+            walls[depth].append(observed(depth)[0])
+    sat_s = statistics.median(sat_walls)
+    walls = {d: round(statistics.median(w), 3) for d, w in walls.items()}
+    _, res = observed(2)
+    frs = engine.frontier_rounds
+    host_split = {
+        "dispatch_s": round(sum(s.dispatch_s for s in frs), 3),
+        "retire_s": round(sum(s.retire_s for s in frs), 3),
+        "speculative_rounds": sum(1 for s in frs if s.inflight > 0),
+    }
+    rounds = max(res.iterations, 1)
+    round_s = walls[1] / rounds
+
+    def paired_ab(obs, pairs):
+        """Interleaved sync/depth-2 pairs under observer ``obs``;
+        the median of per-pair ratios is drift-immune."""
+        syncs, p2s, ratios = [], [], []
+        observed(2, obs)  # warm/settle
+        for _ in range(pairs):
+            ws, _ = observed(1, obs)
+            wp, _ = observed(2, obs)
+            syncs.append(ws)
+            p2s.append(wp)
+            ratios.append(ws / wp)
+        return {
+            "sync_wall_s": round(statistics.median(syncs), 3),
+            "depth2_wall_s": round(statistics.median(p2s), 3),
+            "speedup": round(statistics.median(ratios), 2),
+        }
+
+    # ---- I/O-observer A/B: the headline serving regime
+    io_wait = 0.7 * round_s
+
+    def io_obs(it, d, ch):
+        time.sleep(io_wait)
+
+    io_ab = paired_ab(io_obs, 5)
+    io_ab["observer_wait_s_per_round"] = round(io_wait, 4)
+    io_ab["depth2_vs_saturate"] = round(io_ab["depth2_wall_s"] / sat_s, 2)
+
+    # ---- CPU-observer A/B: the contention-bounded floor.  The numpy
+    # unit is WARM-calibrated (median after warmup): the first pass
+    # pays cold allocation and would overstate the unit ~10x
+    blob = np.random.default_rng(0).random(1_000_000)
+    for _ in range(5):
+        float(np.sum(np.sqrt(blob)))
+    t0 = time.time()
+    for _ in range(20):
+        float(np.sum(np.sqrt(blob)))
+    chunk_s = max((time.time() - t0) / 20, 1e-4)
+    chunks = max(1, int(0.7 * round_s / chunk_s))
+
+    def cpu_obs(it, d, ch):
+        for _ in range(chunks):
+            float(np.sum(np.sqrt(blob)))
+
+    cpu_ab = paired_ab(cpu_obs, 3)
+    cpu_ab["observer_load_s_per_round"] = round(chunks * chunk_s, 4)
+
+    # ---- inline-dispatch microprobe (why dispatch goes through the
+    # executor): on this jax/CPU runtime the observe program executes
+    # INLINE at dispatch — the dispatch call absorbs the round's wall
+    # and the later fetch returns immediately — so merely deferring
+    # the device_get would hide nothing; the pipeline's single-worker
+    # executor moves round execution off the control thread instead
+    engine._ensure_observe_jit()
+    sp, rp = engine.initial_state()
+    dirty = engine.initial_dirty()
+    sp, rp, ch, bits, dirty = engine._observe_jit(
+        sp, rp, dirty, engine._masks
+    )
+    jax.block_until_ready((sp, rp, ch, bits, dirty))
+    d_s, f_s = [], []
+    for _ in range(4):
+        t0 = time.time()
+        sp, rp, ch, bits, dirty = engine._observe_jit(
+            sp, rp, dirty, engine._masks
+        )
+        d_s.append(time.time() - t0)
+        t0 = time.time()
+        jax.block_until_ready((ch, bits))
+        f_s.append(time.time() - t0)
+    dispatch_med = statistics.median(d_s)
+    fetch_med = statistics.median(f_s)
+
+    return {
+        "corpus": f"galen_shaped_{n_classes // 1000}k_chain{chain_depth}",
+        "n_concepts": idx.n_concepts,
+        "rounds": res.iterations,
+        "saturate_wall_s": round(sat_s, 3),
+        "observed_wall_s": {
+            "sync": walls[1], "depth2": walls[2], "depth4": walls[4],
+        },
+        "depth2_vs_saturate": round(walls[2] / sat_s, 2),
+        "sync_vs_depth2": round(walls[1] / walls[2], 2),
+        "host_split": host_split,
+        "io_observer": io_ab,
+        "cpu_observer": cpu_ab,
+        "inline_dispatch": {
+            "dispatch_s": round(dispatch_med, 4),
+            "fetch_s": round(fetch_med, 4),
+            # True ⇒ the dispatch call absorbs the round's execution
+            # (the later fetch is ~instant): deferring the device_get
+            # alone hides nothing on this backend — the executor is
+            # what makes the overlap real
+            "inline": bool(
+                dispatch_med > max(10 * fetch_med, 0.002)
+            ),
+        },
+    }
+
+
 def _run_bench(load1_start: float) -> None:
     import jax
 
@@ -444,6 +667,18 @@ def _run_bench(load1_start: float) -> None:
     engine = RowPackedSaturationEngine(idx)
     result, cold_s, warm_s = _saturate_timed(engine)
     engine_dps = result.derivations / warm_s
+    # checkpoint the headline the moment it exists: a backend
+    # black-hole later in the run (r05 mode) keeps this measured
+    _partial(
+        saturation={
+            "corpus": f"snomed_shaped_{n_classes // 1000}k",
+            "derivations_per_sec": round(engine_dps, 1),
+            "wall_s_warm": round(warm_s, 3),
+            "wall_s_cold": round(cold_s, 3),
+            "derivations": result.derivations,
+            "iterations": result.iterations,
+        }
+    )
 
     # measured tunnel round-trip (a trivial device call), so readers can
     # tell when a warm number is latency- rather than compute-dominated
@@ -652,6 +887,14 @@ def _run_bench(load1_start: float) -> None:
         # rounds line up); the record carries per-round tier + density
         # and the low-density speedup at matched iterations.
         extra["sparse_tail"] = _sparse_tail_probe()
+        _partial(sparse_tail=extra["sparse_tail"])
+
+        # ---- pipelined observed saturation (ISSUE 5): speculative
+        # round dispatch with deferred frontier folds — raw walls vs
+        # saturate()/sync, the loaded-observer hiding A/B, and the
+        # dependent-dispatch microprobe that bounds what CPU can show
+        extra["pipelined_observed"] = _pipeline_probe()
+        _partial(pipelined_observed=extra["pipelined_observed"])
 
     budgeted_ratio = round(engine_dps / oracle_dps, 2)
     print(

@@ -129,6 +129,17 @@ class ClassifierConfig:
     #: consecutive below-threshold rounds required before switching to
     #: the sparse tier (switching back to dense is immediate)
     sparse_hysteresis_rounds: int = 2
+    #: pipelined observation (rowpacked engine, observed runs): dense
+    #: rounds depend only on device-carried state, so up to
+    #: ``pipeline_depth`` rounds stay speculatively in flight while the
+    #: host retires earlier rounds' changed/bits/frontier folds from a
+    #: queue — per-round observability without a blocking host sync per
+    #: superstep.  Byte-identical per retired round to the synchronous
+    #: loop; the adaptive controller drains the queue before any sparse
+    #: tier switch.
+    pipeline: bool = True
+    #: maximum speculatively in-flight observed rounds (1 = synchronous)
+    pipeline_depth: int = 2
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -191,6 +202,10 @@ class ClassifierConfig:
             cfg.sparse_hysteresis_rounds = int(
                 raw["sparse_tail.hysteresis_rounds"]
             )
+        if "pipeline.enable" in raw:
+            cfg.pipeline = raw["pipeline.enable"].lower() == "true"
+        if "pipeline.depth" in raw:
+            cfg.pipeline_depth = int(raw["pipeline.depth"])
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v
@@ -206,6 +221,15 @@ class ClassifierConfig:
             "density_threshold": self.sparse_density_threshold,
             "capacity_buckets": self.sparse_capacity_buckets,
             "hysteresis_rounds": self.sparse_hysteresis_rounds,
+        }
+
+    def pipeline_config(self) -> dict:
+        """The rowpacked engine's ``pipeline=`` kwarg for this config:
+        the pipelined-observation posture of observed saturation runs
+        (``{"enable": False}`` restores the synchronous loop)."""
+        return {
+            "enable": self.pipeline,
+            "depth": self.pipeline_depth,
         }
 
     def matmul_jnp_dtype(self):

@@ -188,12 +188,31 @@ def check_embed_fits(allow_shrink: bool, **dims: Tuple[int, int]) -> None:
 
 def observed_loop(
     observe_step, s, r, init_total: int, unroll: int, budget: int, observer,
-    state_observer=None,
+    state_observer=None, pipeline_depth: int = 1, round_stats=None,
 ):
     """Shared superstep/observer protocol of both engines'
     ``saturate_observed``: run ``observe_step`` (returning
     ``(s, r, changed, live_bits)``) until convergence or budget, calling
     ``observer(iteration, derivations, changed)`` after each round.
+
+    ``pipeline_depth > 1`` runs the loop PIPELINED: up to ``depth``
+    rounds are speculatively dispatched before the oldest round's
+    ``changed``/``bits`` fold is retired from the in-flight queue —
+    rounds depend only on device-carried state, so round N+1's device
+    execution overlaps round N's host fold.  Dispatch goes through a
+    dedicated single-worker executor, which makes the overlap real
+    even on backends whose dispatch is blocking (the jax CPU runtime
+    executes this program inline at dispatch; a true async-dispatch
+    accelerator pays one cheap indirection).
+    The retired sequence (per-round totals, observer calls, the final
+    state) is byte-identical to the synchronous loop: the same step
+    programs run in the same order, only the host-side fetch is
+    deferred.  On convergence at round N, the ≤depth-1 speculatively
+    dispatched extra rounds are no-ops at the fixed point (every rule
+    is a monotone OR — their derivation deltas are provably zero): they
+    are dropped unretired and excluded from iteration/derivation
+    accounting, so converged results report the true fixed-point round
+    count.
 
     ``state_observer(iteration, derivations, changed, s, r)`` — if given —
     additionally receives the LIVE device state after each round, so a
@@ -202,7 +221,17 @@ def observed_loop(
     because in-flight state was never persisted).  The callback runs
     synchronously between rounds; the arrays it sees are the round's
     outputs and are not donated until the next ``observe_step`` call, so
-    fetching them inside the callback is race-free.
+    fetching them inside the callback is race-free.  That contract is
+    incompatible with speculative dispatch (a retired round's arrays
+    would already be donated into the next in-flight round), so a
+    ``state_observer`` forces ``pipeline_depth`` to 1.
+
+    ``round_stats(iteration, delta, changed, dispatch_s, retire_s,
+    inflight)`` — if given — is called once per RETIRED round with the
+    round's derivation delta and its host-time split (``inflight`` is
+    the queue occupancy when the round was dispatched; 0 means it was
+    dispatched synchronously) — the hook the engines hang per-round
+    ``FrontierStats`` telemetry on.
 
     The state arrives in the CALLING ENGINE's working layout — wire-packed
     subsumer-major uint32 (sp, rp) from ``RowPackedSaturationEngine``, but
@@ -211,19 +240,107 @@ def observed_loop(
     be saved as a ``transposed=True`` wire snapshot
     (``runtime/checkpoint.py`` v2); wrapping dense bool arrays that way
     would persist garbage words without an error."""
+    import time as _time
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    depth = max(int(pipeline_depth), 1)
+    if state_observer is not None:
+        depth = 1
     iteration, converged, total = 0, False, init_total
-    while iteration < budget:
-        s, r, changed_dev, bits = observe_step(s, r)
-        iteration += unroll
-        changed, bits_host = fetch_global((changed_dev, bits))
-        total = _host_bit_total(bits_host)
-        if observer is not None:
-            observer(iteration, total - init_total, bool(changed))
-        if state_observer is not None:
-            state_observer(iteration, total - init_total, bool(changed), s, r)
-        if not changed:
-            converged = True
-            break
+    dispatched = 0
+    pending = deque()  # (iteration_after, handle, dispatch_s)
+    # depth > 1: rounds run on a dedicated single-worker executor, so
+    # round N+1's device execution overlaps round N's host retire/fold/
+    # observer work even when the backend's dispatch is blocking (the
+    # jax CPU runtime executes this program INLINE at dispatch — a
+    # deferred device_get alone would hide nothing there; on a true
+    # async-dispatch backend the executor hop is one cheap indirection).
+    # One worker + FIFO submission keeps the round order — and thus the
+    # retired sequence — byte-identical to the synchronous loop.
+    pool = (
+        ThreadPoolExecutor(1, thread_name_prefix="observed-pipeline")
+        if depth > 1
+        else None
+    )
+    latest = None  # newest dispatched round's future (pool mode only)
+    try:
+        while True:
+            # keep the device queue full: dispatch until the queue holds
+            # ``depth`` rounds (depth 1 == the synchronous loop: one
+            # dispatch, immediately retired below)
+            while dispatched < budget and len(pending) < depth:
+                t0 = _time.perf_counter()
+                if pool is None:
+                    s, r, changed_dev, bits = observe_step(s, r)
+                    handle = (changed_dev, bits)
+                else:
+                    # producer/consumer split: the worker runs the
+                    # round AND fetches its observables to the host, so
+                    # every device-side wait — including the jax CPU
+                    # runtime's dispatch quirks (dependent dispatch
+                    # blocks holding the GIL; dispatch may execute the
+                    # program inline) — lands on the worker thread,
+                    # overlapped with the main thread's fold/observer
+                    # work.  The future resolves to HOST values; the
+                    # single worker runs tasks in order, so ``prev`` is
+                    # done before the closure starts and result() is
+                    # instant
+                    def _run(prev=latest, s0=s, r0=r):
+                        a, b = (s0, r0) if prev is None else prev.result()[:2]
+                        a, b, changed_d, bits_d = observe_step(a, b)
+                        return (a, b) + fetch_global((changed_d, bits_d))
+
+                    handle = latest = pool.submit(_run)
+                dispatch_s = _time.perf_counter() - t0
+                dispatched += unroll
+                pending.append((dispatched, handle, dispatch_s))
+            if not pending:
+                break  # budget exhausted without convergence
+            it_after, handle, dispatch_s = pending.popleft()
+            inflight = len(pending)
+            t0 = _time.perf_counter()
+            if pool is None:
+                changed, bits_host = fetch_global(handle)
+            else:
+                _, _, changed, bits_host = handle.result()
+            retire_s = _time.perf_counter() - t0
+            prev_total = total
+            total = _host_bit_total(bits_host)
+            iteration = it_after
+            if round_stats is not None:
+                # before ``observer``, so an observer that correlates
+                # per-round telemetry (scale_probe's progress lines) sees
+                # THIS round's stats, matching the adaptive controller's
+                # frontier_observer-then-observer ordering
+                round_stats(
+                    iteration, total - prev_total, bool(changed),
+                    dispatch_s, retire_s, inflight,
+                )
+            if observer is not None:
+                observer(iteration, total - init_total, bool(changed))
+            if state_observer is not None:
+                # depth is 1 here, so s/r ARE this round's outputs and
+                # the next dispatch (which would donate them) has not
+                # happened
+                state_observer(
+                    iteration, total - init_total, bool(changed), s, r
+                )
+            if not changed:
+                # drop the in-flight speculative rounds: at the fixed
+                # point they change nothing (s/r — the newest dispatched
+                # round's outputs — are byte-identical to this round's),
+                # and their iterations never count
+                converged = True
+                break
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
+    if latest is not None:
+        # pool mode: the main-thread s/r are stale — the current state
+        # is the newest dispatched round's outputs (resolved: shutdown
+        # above waited out the queue)
+        s, r = latest.result()[:2]
     return s, r, iteration, total, converged
 
 
@@ -570,15 +687,20 @@ class SaturationEngine:
         state_observer=None,
         initial: Optional[Tuple[jax.Array, jax.Array]] = None,
         allow_incomplete: bool = False,
+        pipeline_depth: int = 1,
     ) -> SaturationResult:
         """Fixed point with per-superstep observation.
 
         The observable analog of the reference's progress plane: the
         pub-sub gossip consumed by ``worksteal/ProgressMessageHandler.java``
         and the timed completeness snapshots of ``misc/ResultSnapshotter.java``.
-        One fused program per superstep instead of one per run — slower
-        than :meth:`saturate` (a host sync per superstep), so use it for
-        monitoring/analysis, not benchmarking.
+        One fused program per superstep instead of one per run.  With
+        ``pipeline_depth > 1`` the per-superstep host fold is retired
+        from an in-flight queue instead of blocking each round (see
+        :func:`observed_loop`), which recovers most of
+        :meth:`saturate`'s wall time while keeping the per-round
+        observation; at the default depth 1 each round still pays a
+        blocking host sync.
 
         ``observer`` is called after every superstep with
         ``(iteration, derivations_so_far, changed)``.
@@ -600,7 +722,7 @@ class SaturationEngine:
         budget = _pad_up(max_iters, self.unroll)
         s, r, iteration, total, converged = observed_loop(
             self._observe_jit, s, r, init_total, self.unroll, budget, observer,
-            state_observer=state_observer,
+            state_observer=state_observer, pipeline_depth=pipeline_depth,
         )
         packed_s, packed_r = self._pack_jit(s), self._pack_jit(r)
         return self._finish(

@@ -90,6 +90,7 @@ from distel_tpu.ops.bitpack import (
     bit_lookup_from,
 )
 from distel_tpu.runtime.instrumentation import (
+    FRONTIER_EVENTS,
     CompileStats,
     FrontierStats,
     compile_watch,
@@ -310,6 +311,7 @@ class RowPackedSaturationEngine:
         bucket: bool = False,
         bucket_ratio: float = 1.25,
         sparse_tail: Optional[dict] = None,
+        pipeline: Optional[dict] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -381,7 +383,18 @@ class RowPackedSaturationEngine:
         scanned-CR4/CR6 engines only (the controller quietly stays
         dense otherwise); overflow past the largest workspace rung
         falls back to the dense step for that round — work is delayed
-        at most, never dropped."""
+        at most, never dropped.
+        ``pipeline``: pipelined-observation config for
+        ``saturate_observed`` (keys ``enable``, ``depth``; None = the
+        defaults, enabled at depth 2): dense observed rounds depend
+        only on device-carried state, so up to ``depth`` rounds stay
+        in flight while the host retires earlier rounds'
+        ``changed``/bits/frontier folds from a queue.  Byte-identical
+        per retired round to the depth-1 synchronous loop; the
+        adaptive controller drains the queue before any sparse tier
+        switch, so a switch can shift later by up to depth-1 rounds
+        (within the hysteresis slack) without changing what any round
+        derives."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
@@ -1415,6 +1428,9 @@ class RowPackedSaturationEngine:
         #: per-capacity AOT executables, build telemetry, per-round
         #: frontier records of the last saturate_observed run
         self._sparse_cfg = self._normalize_sparse_cfg(sparse_tail)
+        #: pipelined-observation config (runtime-only: never part of the
+        #: traced program, so it stays out of the bucket signature)
+        self._pipeline_cfg = self._normalize_pipeline_cfg(pipeline)
         self._aot_sparse: dict = {}
         self._sparse_builds: list = []
         self._sparse_const_cache = None
@@ -1788,6 +1804,34 @@ class RowPackedSaturationEngine:
                 "sparse_tail hysteresis_rounds must be >= 1 "
                 f"(got {cfg['hysteresis_rounds']!r})"
             )
+        return cfg
+
+    _PIPELINE_DEFAULTS = {"enable": True, "depth": 2}
+
+    @classmethod
+    def _normalize_pipeline_cfg(cls, raw) -> dict:
+        """Resolved pipelined-observation config.  Unlike
+        ``sparse_tail`` (where None means off), None means the
+        DEFAULTS — pipelining replays the synchronous loop's rounds
+        byte-for-byte with only the host fetch deferred, so it is safe
+        on by default.  ``False`` / ``{"enable": False}`` / depth 1
+        restore the strictly synchronous loop."""
+        cfg = dict(cls._PIPELINE_DEFAULTS)
+        if raw is None or raw is True:
+            return cfg
+        if raw is False:
+            cfg["enable"] = False
+            return cfg
+        unknown = set(raw) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown pipeline keys: {sorted(unknown)}")
+        cfg.update(raw)
+        if int(cfg["depth"]) < 1:
+            raise ValueError(
+                f"pipeline depth must be >= 1 (got {cfg['depth']!r})"
+            )
+        cfg["depth"] = int(cfg["depth"])
+        cfg["enable"] = bool(cfg["enable"])
         return cfg
 
     def _sparse_supported(self) -> bool:
@@ -3463,22 +3507,52 @@ class RowPackedSaturationEngine:
 
     def _saturate_adaptive(
         self, cfg, sp, rp, init_total, budget, observer, state_observer,
-        frontier_observer,
+        frontier_observer, pipeline_depth: int = 1,
     ):
-        """The dense/sparse controller loop (single device).  Per
-        round: fold the previous round's frontier on the host, measure
-        density, and pick the tier — dense (the regular ``unroll``-step
-        observed round) above ``density_threshold`` or on workspace
-        overflow; sparse (one frontier-compacted superstep) once
+        """The dense/sparse controller loop (single device), with
+        pipelined dense dispatch.  Per retired round: measure density
+        from the frontier the round consumed, track hysteresis, and
+        pick the tier — dense (the regular ``unroll``-step observed
+        round) above ``density_threshold`` or on workspace overflow;
+        sparse (one frontier-compacted superstep) once
         ``hysteresis_rounds`` consecutive rounds measured below it
         (switching back is immediate).  The host carries the full
         frontier (changed-S mask, per-L-chunk dirty flags, gate flags),
         so the tiers interleave freely; sparse rounds return the fold
         directly plus a live-bit delta, skipping the dense round's
-        full-state popcount sweep."""
-        from distel_tpu.runtime.instrumentation import FRONTIER_EVENTS
+        full-state popcount sweep.
+
+        Dense rounds depend only on device-carried state (sp/rp and
+        the dirty carry never visit the host between rounds), so while
+        nothing suggests a tier switch the controller keeps up to
+        ``pipeline_depth`` rounds in flight: round N+1 is dispatched
+        immediately after round N and round N's ``changed``/bits/
+        frontier fold retires later from the queue — dispatch runs on
+        a dedicated single-worker executor, so device execution
+        overlaps the host folds even where the backend's dispatch is
+        blocking.  Each retire
+        replays the synchronous controller's pre-round measure (the
+        host copies hold the PREVIOUS round's frontier, because retires
+        happen in dispatch order), so per-round records match the
+        synchronous controller's.  Sparse rounds need the host
+        compaction plan, so the pipeline drains before any tier
+        switch: the density/hysteresis decision acts on a frontier
+        stale by at most the pipeline depth, which can delay a switch
+        by up to depth-1 rounds — within the hysteresis slack, and
+        never changing what any round derives (the sparse tier is
+        byte-identical per round to the dense step).  On convergence
+        the ≤depth-1 speculatively dispatched extra rounds are
+        fixed-point no-ops (monotone OR derives nothing new): dropped
+        unretired, excluded from iteration/derivation accounting."""
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
 
         self._ensure_observe_jit()
+        depth = max(int(pipeline_depth), 1)
+        if state_observer is not None:
+            # the snapshot contract hands over live, not-yet-donated
+            # round state — incompatible with speculative dispatch
+            depth = 1
         n_flags = self._gate["n_flags"] if self._gate else 0
         gate_flags = np.ones(max(n_flags, 1), bool)
         s_chg = np.ones(self.nc, bool)
@@ -3486,85 +3560,255 @@ class RowPackedSaturationEngine:
         any_r = True
         below = 0
         iteration, total, converged = 0, init_total, False
+        dispatched = 0
+        pending = deque()  # in-flight dense rounds, oldest first
+        # depth > 1: dense rounds run on a dedicated single-worker
+        # executor so round N+1's device execution overlaps round N's
+        # host retire/fold/observer work even when the backend's
+        # dispatch is blocking (the jax CPU runtime executes this
+        # program INLINE at dispatch; a true async-dispatch backend
+        # pays one cheap indirection).  One worker + FIFO submission
+        # keeps the round order byte-identical to the synchronous
+        # controller.
+        pool = (
+            ThreadPoolExecutor(1, thread_name_prefix="observed-pipeline")
+            if depth > 1
+            else None
+        )
+        latest = None  # newest dispatched round's future (pool mode)
         self.frontier_rounds = []
-        while iteration < budget:
-            t0 = time.perf_counter()
-            prev_total = total
-            rows_touched, density, measure, over = self._sparse_round_plan(
-                cfg, s_chg, dirty_l, any_r
-            )
-            if density < cfg["density_threshold"]:
-                below += 1
-            else:
-                below = 0
-            want_sparse = (
-                iteration > 0 and below >= cfg["hysteresis_rounds"]
-            )
-            use_sparse = want_sparse and measure is not None
-            if rows_touched == 0:
-                # empty frontier: either tier's step derives nothing —
-                # emit the final no-change round without running one
-                iteration += 1
-                changed = False
-                tier = "idle"
-            elif use_sparse:
-                plan = self._sparse_round_args(measure, dirty_l)
-                exe = self._sparse_aot(*plan["key"])
-                sp, rp, ch_d, delta_d, ms_d, ar_d, dl_d = exe(
-                    sp, rp, self._sparse_args(plan)
-                )
-                ch, delta, s_chg, ar, dirty_l = jax.device_get(
-                    (ch_d, delta_d, ms_d, ar_d, dl_d)
-                )
-                changed = bool(ch)
-                any_r = bool(ar)
-                total += int(delta)
-                gate_flags = self._host_gate_flags(s_chg, any_r)
-                iteration += 1
-                tier = "sparse"
-            else:
-                dirty_dev = (
-                    jnp.asarray(gate_flags),
-                    jnp.asarray(dirty_l),
-                    jnp.asarray(s_chg),
-                )
-                sp, rp, ch_d, bits_d, dirty_d = self._observe_jit(
-                    sp, rp, dirty_dev, self._masks
-                )
-                ch, bits, (gf, dl_, ms_) = fetch_global(
-                    (ch_d, bits_d, dirty_d)
-                )
-                changed = bool(ch)
-                total = _host_bit_total(bits)
-                gate_flags = np.asarray(gf)
-                dirty_l = np.asarray(dl_)
-                s_chg = np.asarray(ms_)
-                any_r = bool(dirty_l.any())
-                iteration += self.unroll
-                tier = "dense"
-            st = FrontierStats(
-                iteration=iteration,
-                tier=tier,
-                density=float(density),
-                rows_touched=rows_touched,
-                total_rows=self._sp_total_rows,
-                derivations=total - prev_total,
-                overflow=bool(want_sparse and measure is None and over),
-                wall_s=time.perf_counter() - t0,
-            )
+
+        def finish_round(st, changed):
+            nonlocal converged
             FRONTIER_EVENTS.record(st)
             self.frontier_rounds.append(st)
             if frontier_observer is not None:
                 frontier_observer(st)
             if observer is not None:
-                observer(iteration, total - init_total, changed)
+                observer(st.iteration, total - init_total, changed)
             if state_observer is not None:
                 state_observer(
-                    iteration, total - init_total, changed, sp, rp
+                    st.iteration, total - init_total, changed, sp, rp
                 )
             if not changed:
                 converged = True
-                break
+
+        def dispatch_dense(dirty_dev, plan):
+            """Enqueue one dense round; ``plan`` is the pre-measured
+            ``(rows_touched, density, overflow)`` when dispatched from
+            the synchronous decision point, None when speculative
+            (measured at retire instead — ``dirty_dev`` is None there:
+            the round chains on the previous round's device dirty
+            carry)."""
+            nonlocal sp, rp, dispatched, latest
+            t0 = time.perf_counter()
+            if pool is None:
+                # depth 1: every round dispatches from the synchronous
+                # decision point (speculative chaining needs pending
+                # rounds, which needs depth > 1 — i.e. a pool)
+                assert dirty_dev is not None
+                sp, rp, ch_d, bits_d, dirty_d = self._observe_jit(
+                    sp, rp, dirty_dev, self._masks
+                )
+                ent = {"ch": ch_d, "bits": bits_d, "dirty": dirty_d}
+            else:
+                # producer/consumer split: the worker runs the round
+                # AND fetches its observables to the host, so every
+                # device-side wait — including the jax CPU runtime's
+                # dispatch quirks (dependent dispatch blocks holding
+                # the GIL; dispatch may execute the program inline) —
+                # lands on the worker thread, overlapped with the main
+                # thread's measure/fold/observer work.  The future
+                # resolves to HOST values; the single worker runs
+                # tasks in order, so ``prev`` is done before the
+                # closure starts and result() is instant
+                def _run(prev=latest, s0=sp, r0=rp, dirty0=dirty_dev):
+                    if prev is None:
+                        a, b, d = s0, r0, dirty0
+                    else:
+                        # [2] is the previous round's DEVICE dirty
+                        # carry — the host copies ride behind it
+                        a, b, d = prev.result()[:3]
+                    a, b, ch_d, bits_d, dirty_d = self._observe_jit(
+                        a, b, d, self._masks
+                    )
+                    return (a, b, dirty_d) + fetch_global(
+                        (ch_d, bits_d, dirty_d)
+                    )
+
+                latest = pool.submit(_run)
+                ent = {"fut": latest}
+            dispatched += self.unroll
+            ent.update({
+                "iteration": dispatched,
+                "dispatch_s": time.perf_counter() - t0,
+                "inflight": len(pending),
+                "plan": plan,
+            })
+            pending.append(ent)
+
+        def retire_dense():
+            """Retire the oldest in-flight dense round: replay the
+            synchronous pre-round measure if it was dispatched
+            speculatively, block on its device results, and fold its
+            frontier into the host copies."""
+            nonlocal total, below, iteration
+            nonlocal gate_flags, dirty_l, s_chg, any_r
+            ent = pending.popleft()
+            if ent["plan"] is None:
+                rows_touched, density, measure, over = (
+                    self._sparse_round_plan(cfg, s_chg, dirty_l, any_r)
+                )
+                if density < cfg["density_threshold"]:
+                    below += 1
+                else:
+                    below = 0
+                over = bool(
+                    below >= cfg["hysteresis_rounds"]
+                    and measure is None and over
+                )
+            else:
+                rows_touched, density, over = ent["plan"]
+            t1 = time.perf_counter()
+            if pool is None:
+                ch, bits, (gf, dl_, ms_) = fetch_global(
+                    (ent["ch"], ent["bits"], ent["dirty"])
+                )
+            else:
+                ch, bits, (gf, dl_, ms_) = ent["fut"].result()[3:]
+            retire_s = time.perf_counter() - t1
+            prev_total = total
+            total = _host_bit_total(bits)
+            gate_flags = np.asarray(gf)
+            dirty_l = np.asarray(dl_)
+            s_chg = np.asarray(ms_)
+            any_r = bool(dirty_l.any())
+            iteration = ent["iteration"]
+            finish_round(
+                FrontierStats(
+                    iteration=iteration,
+                    tier="dense",
+                    density=float(density),
+                    rows_touched=rows_touched,
+                    total_rows=self._sp_total_rows,
+                    derivations=total - prev_total,
+                    overflow=bool(over),
+                    wall_s=ent["dispatch_s"] + retire_s,
+                    dispatch_s=ent["dispatch_s"],
+                    retire_s=retire_s,
+                    inflight=ent["inflight"],
+                ),
+                bool(ch),
+            )
+
+        try:
+              while True:
+                if converged:
+                    break  # drop any still-speculative in-flight rounds
+                if pending:
+                    # speculative regime: while nothing below suggests a
+                    # tier switch, keep the device queue full with dense
+                    # rounds chained on the DEVICE dirty carry; otherwise
+                    # retire toward the next synchronous decision point
+                    if (
+                        below < cfg["hysteresis_rounds"]
+                        and dispatched < budget
+                        and len(pending) < depth
+                    ):
+                        dispatch_dense(None, None)
+                    else:
+                        retire_dense()
+                    continue
+                if iteration >= budget:
+                    break
+                # ---- pipeline drained: the synchronous decision point ----
+                if latest is not None:
+                    # every dispatched round has retired (pending is
+                    # empty), so the newest round's future is resolved:
+                    # re-anchor the main-thread state on its outputs for
+                    # the sparse/idle paths below
+                    sp, rp = latest.result()[:2]
+                    latest = None
+                t0 = time.perf_counter()
+                prev_total = total
+                rows_touched, density, measure, over = self._sparse_round_plan(
+                    cfg, s_chg, dirty_l, any_r
+                )
+                if density < cfg["density_threshold"]:
+                    below += 1
+                else:
+                    below = 0
+                want_sparse = (
+                    iteration > 0 and below >= cfg["hysteresis_rounds"]
+                )
+                use_sparse = want_sparse and measure is not None
+                if rows_touched == 0:
+                    # empty frontier: either tier's step derives nothing —
+                    # emit the final no-change round without running one
+                    iteration += 1
+                    dispatched = iteration
+                    finish_round(
+                        FrontierStats(
+                            iteration=iteration,
+                            tier="idle",
+                            density=float(density),
+                            rows_touched=rows_touched,
+                            total_rows=self._sp_total_rows,
+                            derivations=0,
+                            overflow=False,
+                            wall_s=time.perf_counter() - t0,
+                        ),
+                        False,
+                    )
+                elif use_sparse:
+                    plan = self._sparse_round_args(measure, dirty_l)
+                    exe = self._sparse_aot(*plan["key"])
+                    sp, rp, ch_d, delta_d, ms_d, ar_d, dl_d = exe(
+                        sp, rp, self._sparse_args(plan)
+                    )
+                    ch, delta, s_chg, ar, dirty_l = jax.device_get(
+                        (ch_d, delta_d, ms_d, ar_d, dl_d)
+                    )
+                    any_r = bool(ar)
+                    total += int(delta)
+                    gate_flags = self._host_gate_flags(s_chg, any_r)
+                    iteration += 1
+                    dispatched = iteration
+                    finish_round(
+                        FrontierStats(
+                            iteration=iteration,
+                            tier="sparse",
+                            density=float(density),
+                            rows_touched=rows_touched,
+                            total_rows=self._sp_total_rows,
+                            derivations=total - prev_total,
+                            overflow=False,
+                            wall_s=time.perf_counter() - t0,
+                        ),
+                        bool(ch),
+                    )
+                else:
+                    dirty_dev = (
+                        jnp.asarray(gate_flags),
+                        jnp.asarray(dirty_l),
+                        jnp.asarray(s_chg),
+                    )
+                    dispatch_dense(
+                        dirty_dev,
+                        (
+                            rows_touched, density,
+                            bool(want_sparse and measure is None and over),
+                        ),
+                    )
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
+        if latest is not None:
+            # pool mode: the main-thread sp/rp are stale — the current
+            # state is the newest dispatched round's outputs (on
+            # convergence the dropped speculative rounds are fixed-point
+            # no-ops, so these are byte-identical to the retired state)
+            sp, rp = latest.result()[:2]
         return sp, rp, iteration, total, converged
 
     def saturate_observed(
@@ -3577,14 +3821,20 @@ class RowPackedSaturationEngine:
         allow_incomplete: bool = False,
         sparse_tail=None,
         frontier_observer=None,
+        pipeline=None,
     ) -> SaturationResult:
         """Fixed point with per-superstep observation — the observable
         analog of the reference's progress plane (pub-sub gossip consumed
         by ``worksteal/ProgressMessageHandler.java`` and the timed
-        completeness snapshots of ``misc/ResultSnapshotter.java``).  One
-        host sync per superstep, so use :meth:`saturate` for benchmarks.
-        On a mesh each superstep runs in the same shard_map structure as
-        the fixed point.
+        completeness snapshots of ``misc/ResultSnapshotter.java``).
+        Dense rounds are PIPELINED by default (``pipeline.depth``
+        rounds in flight, host folds retired from a queue — see
+        ``__init__``), so per-round observation no longer costs a
+        blocking host sync per superstep; the retired round sequence
+        stays byte-identical to the synchronous loop.  :meth:`saturate`
+        remains marginally faster (one fused while_loop program, no
+        per-round observability at all).  On a mesh each superstep runs
+        in the same shard_map structure as the fixed point.
 
         ``sparse_tail``: per-call override of the engine's adaptive
         sparse-tail config (see ``__init__``); when active (and the
@@ -3593,7 +3843,15 @@ class RowPackedSaturationEngine:
         frontier-compacted step program and per-round
         :class:`~distel_tpu.runtime.instrumentation.FrontierStats`
         land in ``self.frontier_rounds`` (and ``frontier_observer``,
-        when given)."""
+        when given).  The plain path emits per-round dense-tier
+        ``FrontierStats`` too (density pinned 1.0 — no frontier fold
+        is measured there), so frontier telemetry never goes dark when
+        the sparse tail is off.
+
+        ``pipeline``: per-call override of the engine's pipelined-
+        observation config (``{"enable": ..., "depth": ...}``).  A
+        ``state_observer`` forces the synchronous depth-1 loop — its
+        contract hands over live, not-yet-donated round state."""
         self._ensure_observe_jit()
         if initial is None:
             sp, rp = self.initial_state()
@@ -3612,10 +3870,17 @@ class RowPackedSaturationEngine:
             if sparse_tail is None
             else self._normalize_sparse_cfg(sparse_tail)
         )
+        pcfg = (
+            self._pipeline_cfg
+            if pipeline is None
+            else self._normalize_pipeline_cfg(pipeline)
+        )
+        pdepth = pcfg["depth"] if pcfg["enable"] else 1
         if cfg is not None and self._sparse_supported():
             sp, rp, iteration, total, converged = self._saturate_adaptive(
                 cfg, sp, rp, init_total, budget, observer,
                 state_observer, frontier_observer,
+                pipeline_depth=pdepth,
             )
         else:
             self.frontier_rounds = []
@@ -3627,10 +3892,36 @@ class RowPackedSaturationEngine:
                 )
                 return s, r, ch, bits
 
+            def round_stats(it, delta, changed, dispatch_s, retire_s,
+                            inflight):
+                # dense-tier telemetry from the plain path: no host
+                # frontier fold runs here, so density reports the dense
+                # sweep itself (every rule-table row re-evaluated) —
+                # serve's frontier gauges stay live with the sparse
+                # tail disabled
+                st = FrontierStats(
+                    iteration=it,
+                    tier="dense",
+                    density=1.0,
+                    rows_touched=self._sp_total_rows,
+                    total_rows=self._sp_total_rows,
+                    derivations=delta,
+                    wall_s=dispatch_s + retire_s,
+                    dispatch_s=dispatch_s,
+                    retire_s=retire_s,
+                    inflight=inflight,
+                )
+                FRONTIER_EVENTS.record(st)
+                self.frontier_rounds.append(st)
+                if frontier_observer is not None:
+                    frontier_observer(st)
+
             sp, rp, iteration, total, converged = observed_loop(
                 observe_step,
                 sp, rp, init_total, self.unroll, budget, observer,
                 state_observer=state_observer,
+                pipeline_depth=pdepth,
+                round_stats=round_stats,
             )
         if not converged and not allow_incomplete:
             raise RuntimeError(

@@ -118,6 +118,11 @@ def make_engine(
         rowpacked_kw.setdefault(
             "sparse_tail", config.sparse_tail_config()
         )
+        # pipelined observation for observed runs: speculative round
+        # dispatch with deferred frontier folds (per-round observability
+        # without a blocking host sync per superstep) — the serving
+        # paths run the observed loop, so this is their throughput knob
+        rowpacked_kw.setdefault("pipeline", config.pipeline_config())
         return RowPackedSaturationEngine(idx, **kw, **rowpacked_kw)
     if choice == "packed":
         from distel_tpu.core.packed_engine import PackedSaturationEngine

@@ -150,6 +150,15 @@ class FrontierStats:
     neither tier total); ``overflow`` marks a round whose active set
     exceeded the largest sparse workspace rung, forcing the dense
     fallback.
+
+    Pipelined observation (ISSUE 5) splits the round's blocking host
+    time: ``dispatch_s`` is the async-dispatch cost of enqueueing the
+    round's step program, ``retire_s`` the later blocking fetch+fold of
+    its results, and ``wall_s`` their sum — the HOST time the round
+    cost, which under pipelining is less than the round's wall-clock
+    (device execution overlaps other rounds' host work).  ``inflight``
+    is the pipeline occupancy when the round was dispatched (0 =
+    synchronous dispatch — sparse and idle rounds are always 0).
     Threaded through ``bench.py`` / ``scripts/scale_probe.py`` round
     records and the serve plane's ``/metrics`` gauges (via
     :data:`FRONTIER_EVENTS`)."""
@@ -162,6 +171,9 @@ class FrontierStats:
     derivations: int = 0
     overflow: bool = False
     wall_s: float = 0.0
+    dispatch_s: float = 0.0
+    retire_s: float = 0.0
+    inflight: int = 0
 
     def as_dict(self) -> dict:
         return {
@@ -173,6 +185,9 @@ class FrontierStats:
             "derivations": self.derivations,
             "overflow": self.overflow,
             "wall_s": round(self.wall_s, 4),
+            "dispatch_s": round(self.dispatch_s, 4),
+            "retire_s": round(self.retire_s, 4),
+            "inflight": self.inflight,
         }
 
 
@@ -192,6 +207,14 @@ class FrontierAggregate:
         self.overflow_rounds = 0
         self.last_density = 1.0
         self.last_rows_touched = 0
+        #: pipelined-observation telemetry: occupancy of the speculative
+        #: dispatch queue when the last round went out, and the
+        #: cumulative blocking host seconds split dispatch/retire (the
+        #: overlap win is wall-clock minus their sum)
+        self.last_inflight = 0
+        self.pipelined_rounds = 0
+        self.dispatch_seconds = 0.0
+        self.retire_seconds = 0.0
 
     def record(self, st: "FrontierStats") -> None:
         with self._lock:
@@ -205,6 +228,11 @@ class FrontierAggregate:
                 self.overflow_rounds += 1
             self.last_density = st.density
             self.last_rows_touched = st.rows_touched
+            self.last_inflight = st.inflight
+            if st.inflight > 0:
+                self.pipelined_rounds += 1
+            self.dispatch_seconds += st.dispatch_s
+            self.retire_seconds += st.retire_s
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -214,6 +242,10 @@ class FrontierAggregate:
                 "overflow_rounds": self.overflow_rounds,
                 "last_density": self.last_density,
                 "last_rows_touched": self.last_rows_touched,
+                "last_inflight": self.last_inflight,
+                "pipelined_rounds": self.pipelined_rounds,
+                "dispatch_seconds": self.dispatch_seconds,
+                "retire_seconds": self.retire_seconds,
             }
 
 

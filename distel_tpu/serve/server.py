@@ -190,6 +190,33 @@ class ServeApp:
                 "last_rows_touched",
                 "active rule rows of the last observed saturation round",
             ),
+            # pipelined observation (speculative round dispatch with
+            # deferred frontier folds): queue occupancy + the blocking
+            # host seconds split — overlap won is round wall-clock
+            # minus (dispatch + retire)
+            (
+                "distel_pipeline_inflight",
+                "last_inflight",
+                "speculative queue occupancy when the last observed "
+                "round was dispatched (0 = synchronous)",
+            ),
+            (
+                "distel_pipeline_rounds",
+                "pipelined_rounds",
+                "observed rounds dispatched speculatively (inflight > 0)",
+            ),
+            (
+                "distel_pipeline_dispatch_seconds",
+                "dispatch_seconds",
+                "cumulative blocking host seconds spent dispatching "
+                "observed rounds",
+            ),
+            (
+                "distel_pipeline_retire_seconds",
+                "retire_seconds",
+                "cumulative blocking host seconds spent retiring "
+                "observed rounds' deferred folds",
+            ),
         )
 
         def _frontier_gauges():

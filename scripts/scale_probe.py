@@ -104,6 +104,14 @@ def _parse_args(argv=None):
                          "When active, per-round progress lines carry "
                          "tier/density/rows_touched and the record "
                          "gains a sparse_tail summary")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="speculative in-flight rounds for observed "
+                         "--execute runs (default: the engine's "
+                         "pipeline config, depth 2; 1 = the strictly "
+                         "synchronous loop).  Per-round progress lines "
+                         "carry the dispatch/retire host-time split and "
+                         "the queue occupancy (inflight) so the overlap "
+                         "actually won is visible per round")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.resume_from and not args.execute:
@@ -217,11 +225,16 @@ def run_probe(args) -> None:
         idx, mesh=mesh,
         sparse_tail=(True if want_sparse else None),
         scan_chunks=(True if want_sparse else None),
+        pipeline=(
+            None if args.pipeline_depth is None
+            else {"depth": args.pipeline_depth}
+        ),
     )
     rec["build_s"] = round(time.time() - t0, 1)
     rec["sparse_tail_enabled"] = bool(
         want_sparse and engine._sparse_supported()
     )
+    rec["pipeline"] = dict(engine._pipeline_cfg)
     # resolved program identity + (later) the compile-vs-execute wall
     # split: announced at LAUNCH so a killed multi-hour run still
     # records which bucket/program it was paying for
@@ -356,6 +369,13 @@ def run_probe(args) -> None:
                         line["tier"] = st.tier
                         line["density"] = round(st.density, 5)
                         line["rows_touched"] = st.rows_touched
+                        # pipelined observation: the round's blocking
+                        # host-time split and queue occupancy — wall_s
+                        # minus (dispatch+retire) is the host time the
+                        # deferred fold overlapped with device rounds
+                        line["dispatch_s"] = round(st.dispatch_s, 4)
+                        line["retire_s"] = round(st.retire_s, 4)
+                        line["inflight"] = st.inflight
                     with open(progress, "a") as f:
                         f.write(json.dumps(line) + "\n")
 

@@ -62,3 +62,32 @@ def test_failure_record_carries_attempt_log(monkeypatch, capsys):
     rec = json.loads(capsys.readouterr().out.strip())
     assert rec["failed_stage"] == "backend_init"
     assert rec["attempt_log"][0]["elapsed_s"] == 180.0
+
+
+def test_failure_record_carries_partial_results(capsys):
+    """ISSUE 5 satellite: a failure AFTER saturation keeps the
+    already-measured sections — ``#partial`` checkpoints harvested
+    from the dead child's stdout land in the failure record."""
+    stdout = "\n".join(
+        [
+            "some launch chatter",
+            bench._PARTIAL_PREFIX
+            + json.dumps({"saturation": {"derivations_per_sec": 123.4}}),
+            bench._PARTIAL_PREFIX + json.dumps({"sparse_tail": {"ok": 1}}),
+            bench._PARTIAL_PREFIX + '{"truncated": ',  # mid-write kill
+        ]
+    )
+    merged = bench._collect_partials(stdout)
+    assert merged == {
+        "saturation": {"derivations_per_sec": 123.4},
+        "sparse_tail": {"ok": 1},
+    }
+    bench._emit_failure(
+        "bench_body", RuntimeError("tunnel black-holed"), 2, partial=merged
+    )
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["partial_results"]["saturation"]["derivations_per_sec"] == 123.4
+    # and the empty-partial case stays absent, not null
+    bench._emit_failure("bench_body", RuntimeError("x"), 1, partial={})
+    rec2 = json.loads(capsys.readouterr().out.strip())
+    assert "partial_results" not in rec2

@@ -1,0 +1,306 @@
+"""Pipelined observed saturation (ISSUE 5): speculative round dispatch
+with deferred frontier folds.
+
+The invariant under test: a pipelined observed run is BYTE-IDENTICAL
+per retired round (state + per-round derivation totals + round count)
+to the synchronous depth-1 controller — the same step programs run in
+the same order, only the host fetch is deferred — for depths 1/2/4,
+with and without the adaptive sparse tail, including a forced
+tier-interleave case.  Plus the accounting and telemetry properties:
+speculative overshoot (the ≤depth-1 rounds dispatched past the fixed
+point) is excluded from iteration/derivation accounting; the plain
+non-adaptive observed path emits dense-tier ``FrontierStats`` so
+serve's frontier gauges stay live with the sparse tail off; and the
+controller's host gate-flag replication (``_host_gate_flags``) matches
+the device fold (``_next_dirty``) on randomized masks and randomized
+gate-reader structures."""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.engine import SaturationEngine
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import synthetic_ontology
+from distel_tpu.owl import parser
+from distel_tpu.runtime.instrumentation import FRONTIER_EVENTS
+
+
+def _indexed(text):
+    return index_ontology(normalize(parser.parse(text)))
+
+
+@pytest.fixture(scope="module")
+def galen_idx():
+    """The PR 4 parity fixture: GALEN-shape corpus with a
+    subclass-chain tail — late rounds derive one chain hop each, so
+    the run has a long tail of cheap rounds for the pipeline (and the
+    sparse tier) to work on."""
+    n = 400
+    text = synthetic_ontology(
+        n_classes=n, n_anatomy=n // 10, n_locations=n // 12,
+        n_definitions=n // 20,
+    )
+    text += "\n" + "\n".join(
+        f"SubClassOf(TailChain{i} TailChain{i + 1})" for i in range(12)
+    )
+    text += "\nSubClassOf(Class0 TailChain0)"
+    return _indexed(text)
+
+
+def _observed(idx, sparse, pipeline, **kw):
+    engine = RowPackedSaturationEngine(idx, unroll=1, bucket=True, **kw)
+    rounds = []
+    res = engine.saturate_observed(
+        observer=lambda it, d, ch: rounds.append((it, d, ch)),
+        sparse_tail=sparse,
+        pipeline=pipeline,
+    )
+    return engine, rounds, res
+
+
+def _assert_same_closure(res_a, res_b):
+    assert np.array_equal(
+        np.asarray(res_a.packed_s), np.asarray(res_b.packed_s)
+    )
+    assert np.array_equal(
+        np.asarray(res_a.packed_r), np.asarray(res_b.packed_r)
+    )
+
+
+# ------------------------------------------------ per-round parity
+
+
+@pytest.mark.parametrize(
+    "sparse",
+    [{"enable": False}, True],
+    ids=["plain", "sparse_tail"],
+)
+def test_pipelined_matches_sync_per_round(galen_idx, sparse):
+    """THE parity pin: depths 1/2/4 produce identical per-round
+    (iteration, derivations, changed) sequences, identical final
+    closures and identical converged iteration counts to the
+    synchronous controller — with and without the adaptive sparse
+    tail."""
+    _, sync_rounds, res_sync = _observed(
+        galen_idx, sparse, {"enable": False}
+    )
+    for depth in (1, 2, 4):
+        eng, rounds, res = _observed(
+            galen_idx, sparse, {"enable": True, "depth": depth}
+        )
+        assert rounds == sync_rounds, depth
+        assert res.iterations == res_sync.iterations, depth
+        assert res.derivations == res_sync.derivations, depth
+        _assert_same_closure(res, res_sync)
+        # every retired round is recorded exactly once
+        assert len(eng.frontier_rounds) == len(rounds), depth
+
+
+def test_forced_tier_interleave_parity(galen_idx):
+    """Interleave case: a mid threshold + a one-rung tiny workspace
+    makes sparse-eligible rounds overflow back to dense, so the run
+    interleaves speculative dense phases, sparse rounds and
+    overflow-dense rounds — per-round parity and the final closure
+    must still hold at depth 4."""
+    cfg = {
+        "density_threshold": 0.3,
+        "hysteresis_rounds": 2,
+        "capacity_buckets": 1,
+        "capacity_floor": 8,
+    }
+    eng_s, sync_rounds, res_sync = _observed(
+        galen_idx, cfg, {"enable": False}
+    )
+    eng_p, rounds, res = _observed(
+        galen_idx, cfg, {"enable": True, "depth": 4}
+    )
+    assert rounds == sync_rounds
+    assert res.iterations == res_sync.iterations
+    _assert_same_closure(res, res_sync)
+    tiers = [s.tier for s in eng_p.frontier_rounds]
+    assert "sparse" in tiers and "dense" in tiers
+    # the early dense phase actually ran speculatively
+    assert any(s.inflight > 0 for s in eng_p.frontier_rounds)
+    # the synchronous run never speculates
+    assert all(s.inflight == 0 for s in eng_s.frontier_rounds)
+
+
+# ------------------------------------- speculative overshoot accounting
+
+
+def test_overshoot_excluded_from_accounting(galen_idx):
+    """Converged pipelined results report the TRUE fixed-point round
+    count: the ≤depth-1 rounds speculatively dispatched past
+    convergence are fixed-point no-ops, dropped unretired — not
+    retired rounds, not iterations, not derivations."""
+    _, sync_rounds, res_sync = _observed(
+        galen_idx, {"enable": False}, {"enable": False}
+    )
+    eng, rounds, res = _observed(
+        galen_idx, {"enable": False}, {"enable": True, "depth": 4}
+    )
+    assert res.converged and res_sync.converged
+    assert res.iterations == res_sync.iterations
+    assert res.derivations == res_sync.derivations
+    assert len(rounds) == len(sync_rounds)
+    # pipelining engaged (so overshoot rounds WERE dispatched) ...
+    assert any(s.inflight > 0 for s in eng.frontier_rounds)
+    # ... and the recorded rounds end at the no-change round, with no
+    # overshoot rounds after it
+    assert eng.frontier_rounds[-1].iteration == res.iterations
+    assert eng.frontier_rounds[-1].derivations == 0
+
+
+def test_state_observer_forces_synchronous(galen_idx):
+    """A ``state_observer`` receives live not-yet-donated round state —
+    incompatible with speculation — so depth collapses to 1 and the
+    snapshots still line up with the observer rounds."""
+    engine = RowPackedSaturationEngine(galen_idx, unroll=1, bucket=True)
+    seen = []
+    res = engine.saturate_observed(
+        state_observer=lambda it, d, ch, sp, rp: seen.append(
+            (it, int(np.asarray(sp[0]).sum() >= 0))
+        ),
+        sparse_tail={"enable": False},
+        pipeline={"enable": True, "depth": 4},
+    )
+    assert len(seen) == len(engine.frontier_rounds)
+    assert all(s.inflight == 0 for s in engine.frontier_rounds)
+    assert seen[-1][0] == res.iterations
+
+
+def test_pipeline_cfg_validation(galen_idx):
+    for bad in ({"depth": 0}, {"nope": 1}):
+        with pytest.raises(ValueError):
+            RowPackedSaturationEngine(
+                galen_idx, unroll=1, bucket=True, pipeline=bad
+            )
+
+
+# ------------------------- plain-path FrontierStats (serve gauges)
+
+
+def test_plain_observed_path_emits_frontier_stats(galen_idx):
+    """With the sparse tail disabled the plain observed loop still
+    emits per-round dense-tier FrontierStats (density pinned 1.0 — no
+    frontier fold is measured there) into engine.frontier_rounds AND
+    the process-global aggregate, so serve's frontier gauges don't go
+    dark when ``sparse_tail.enable=false``."""
+    before = FRONTIER_EVENTS.snapshot()
+    eng, rounds, res = _observed(
+        galen_idx, {"enable": False}, {"enable": True, "depth": 2}
+    )
+    after = FRONTIER_EVENTS.snapshot()
+    assert eng.frontier_rounds, "plain path emitted no FrontierStats"
+    assert all(s.tier == "dense" for s in eng.frontier_rounds)
+    assert all(s.density == 1.0 for s in eng.frontier_rounds)
+    assert all(
+        s.rows_touched == s.total_rows == eng._sp_total_rows
+        for s in eng.frontier_rounds
+    )
+    assert sum(s.derivations for s in eng.frontier_rounds) == res.derivations
+    assert (
+        after["dense_rounds"] - before["dense_rounds"]
+        == len(eng.frontier_rounds)
+    )
+    # wall split present: wall is the blocking host time of the round
+    assert all(
+        abs(s.wall_s - (s.dispatch_s + s.retire_s)) < 1e-9
+        for s in eng.frontier_rounds
+    )
+
+
+# --------------------- host gate flags vs the device _next_dirty fold
+
+
+def test_host_gate_flags_matches_device_fold(galen_idx):
+    """Property pin for the controller's host replication of the
+    device gate fold: for random changed-S masks and any-R flags, the
+    flags ``_host_gate_flags`` hands a dense round after sparse rounds
+    must equal what ``_next_dirty`` would have folded on device from
+    the same inputs."""
+    import jax.numpy as jnp
+
+    eng = RowPackedSaturationEngine(galen_idx, unroll=1, gate_chunks=True)
+    assert eng._gate is not None, "fixture must build a gated engine"
+    rng = np.random.default_rng(7)
+    for trial in range(16):
+        p = rng.choice([0.0, 0.002, 0.05, 0.5, 1.0])
+        mask_s = rng.random(eng.nc) < p
+        any_r = bool(rng.integers(2))
+        host = eng._host_gate_flags(mask_s, any_r)
+        dev = np.asarray(
+            eng._next_dirty(jnp.asarray(mask_s), jnp.asarray(any_r), None)
+        )
+        assert np.array_equal(host, dev), (trial, p, any_r)
+
+
+def test_host_gate_flags_matches_device_fold_random_readers(galen_idx):
+    """Same property over RANDOMIZED gate-reader structures (kind mix,
+    reader-row sets, flag order) — the reader shapes a real ontology
+    happens to produce must not be the only covered ones."""
+    import jax.numpy as jnp
+
+    eng = RowPackedSaturationEngine(galen_idx, unroll=1, gate_chunks=True)
+    rng = np.random.default_rng(11)
+    orig = eng._gate
+    try:
+        for trial in range(12):
+            readers = []
+            for _ in range(int(rng.integers(1, 6))):
+                kind = ["SR", "RR", "CR5"][int(rng.integers(3))]
+                if kind == "SR":
+                    k = int(rng.integers(0, 6))
+                    rows = np.sort(
+                        rng.choice(eng.nc, size=k, replace=False)
+                    ).astype(np.int64)
+                    readers.append(("SR", rows))
+                else:
+                    readers.append((kind, None))
+            eng._gate = {"readers": readers, "n_flags": len(readers)}
+            mask_s = rng.random(eng.nc) < rng.choice([0.003, 0.2])
+            any_r = bool(rng.integers(2))
+            host = eng._host_gate_flags(mask_s, any_r)
+            dev = np.asarray(
+                eng._next_dirty(
+                    jnp.asarray(mask_s), jnp.asarray(any_r), None
+                )
+            )
+            assert np.array_equal(host, dev), (trial, readers)
+    finally:
+        eng._gate = orig
+
+
+# ------------------------------- dense engine's pipelined observed loop
+
+
+def test_dense_engine_pipelined_observed_matches():
+    """engine.py's observed_loop grew the same deferred-retire
+    structure: the dense SaturationEngine at pipeline_depth=3 retires
+    the identical round sequence and closure as the synchronous run."""
+    text = synthetic_ontology(
+        n_classes=160, n_anatomy=16, n_locations=14, n_definitions=8,
+    )
+    text += "\n" + "\n".join(
+        f"SubClassOf(DTail{i} DTail{i + 1})" for i in range(8)
+    )
+    text += "\nSubClassOf(Class0 DTail0)"
+    idx = _indexed(text)
+
+    def run(depth):
+        eng = SaturationEngine(idx, unroll=1)
+        rounds = []
+        res = eng.saturate_observed(
+            observer=lambda it, d, ch: rounds.append((it, d, ch)),
+            pipeline_depth=depth,
+        )
+        return rounds, res
+
+    rounds_sync, res_sync = run(1)
+    rounds_pipe, res_pipe = run(3)
+    assert rounds_pipe == rounds_sync
+    assert res_pipe.iterations == res_sync.iterations
+    assert res_pipe.derivations == res_sync.derivations
+    assert res_pipe.subsumer_dict() == res_sync.subsumer_dict()
