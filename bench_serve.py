@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Traffic-shaped load benchmark for the serve fleet.
+
+The serving analog of ``bench.py``: boots a real fleet (router
+in-process, N shared-nothing replica subprocesses via the supervisor),
+drives it with concurrent clients issuing a mixed op stream — deltas
+(the classify work), taxonomy reads, subsumer point reads, and an
+occasional fresh ontology load — and records per-op p50/p99 latency plus
+aggregate classify (delta-saturation) throughput.  Re-run across replica
+counts (``--replicas 1 2 4``) it measures horizontal scaling; with
+``--migrate-under-load`` it performs a LIVE ontology migration mid-run
+and asserts the fleet contract: zero failed requests and byte-identical
+taxonomy before/after the move.
+
+Throughput here is bounded by host cores: every replica is one Python
+process executing jax CPU programs inline (one GIL each), so a 2-core
+host tops out near 2x regardless of replica count — the record carries
+``host.cores`` so the number reads honestly.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench_serve.py --replicas 1 2 4 \
+        --clients 6 --duration-s 20 --migrate-under-load \
+        --out BENCH_SERVE_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+#: classes per tenant ontology — enough distinct pairs that the
+#: assertion traffic below keeps finding new axioms to push
+_N_CLASSES = 12
+
+
+def _mk_ontology(i: int) -> str:
+    """One small ontology per simulated tenant — identical SHAPE (one
+    bucket: the compile cache serves every replica) but distinct names:
+    a subclass chain plus one existential so CR3/CR4 stay exercised."""
+    p = f"T{i}"
+    lines = [
+        f"SubClassOf({p}C{k} {p}C{k + 1})" for k in range(_N_CLASSES - 1)
+    ]
+    lines += [
+        f"SubClassOf({p}C0 ObjectSomeValuesFrom(r{i} {p}C{_N_CLASSES - 1}))",
+        f"SubClassOf(ObjectSomeValuesFrom(r{i} {p}C{_N_CLASSES - 1}) "
+        f"{p}C1)",
+    ]
+    return "\n".join(lines)
+
+
+class ClientWorker(threading.Thread):
+    """One simulated tenant: owns one ontology, loops a shaped op mix
+    (2/3 classify deltas, 1/4 taxonomy reads, the rest point reads),
+    records (op, wall_s, ok) samples.  ``pause_writes`` quiesces the
+    write side (the migration window needs a stable before/after
+    taxonomy) while reads keep flowing."""
+
+    def __init__(self, idx, client, oid, stop, samples, failures):
+        super().__init__(name=f"bench-client-{idx}", daemon=True)
+        self.idx = idx
+        self.client = client
+        self.oid = oid
+        self.stop_ev = stop
+        self.samples = samples
+        self.failures = failures
+        self.pause_writes = threading.Event()
+        self.writes_quiesced = threading.Event()
+        self._i = 0
+
+    def run(self):
+        prefix = f"T{self.idx}"
+        while not self.stop_ev.is_set():
+            i = self._i
+            self._i += 1
+            if i % 12 < 8:
+                if self.pause_writes.is_set():
+                    self.writes_quiesced.set()
+                    time.sleep(0.01)
+                    continue
+                op = "classify"
+                if i % 40 == 39:
+                    # occasional GROWTH delta: a new concept widens the
+                    # corpus (the expensive shape-changing traffic)
+                    text = f"SubClassOf({prefix}New{i} {prefix}C0)"
+                else:
+                    # assertion traffic over existing concepts: the
+                    # common production shape (no layout change)
+                    a = (7 * i) % _N_CLASSES
+                    b = (a + 1 + i % (_N_CLASSES - 2)) % _N_CLASSES
+                    if a == b:
+                        b = (b + 1) % _N_CLASSES
+                    text = (
+                        f"SubClassOf({prefix}C{a} {prefix}C{b})"
+                    )
+                fn = lambda: self.client.delta(  # noqa: E731
+                    self.oid, text
+                )
+            elif i % 12 < 11:
+                op = "taxonomy"
+                fn = lambda: self.client.taxonomy(self.oid)  # noqa: E731
+            else:
+                op = "subsumers"
+                fn = lambda: self.client.subsumers(  # noqa: E731
+                    self.oid, f"{prefix}C0"
+                )
+            t0 = time.monotonic()
+            try:
+                fn()
+                self.samples.append((op, time.monotonic() - t0, True))
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                self.samples.append((op, time.monotonic() - t0, False))
+                self.failures.append((self.name, op, repr(e)))
+
+
+def run_scenario(
+    n_replicas: int,
+    *,
+    clients: int,
+    duration_s: float,
+    spill_root: str,
+    migrate_under_load: bool,
+    label: str = "",
+    router_port: int = 0,
+) -> dict:
+    from distel_tpu.serve.client import ServeClient
+    from distel_tpu.serve.fleet.router import RouterApp
+    from distel_tpu.serve.fleet.supervisor import ReplicaSupervisor
+    from distel_tpu.serve.server import make_server
+
+    spill = os.path.join(spill_root, f"fleet{n_replicas}{label}")
+    # one scheduler worker per replica: jax CPU executes inline holding
+    # the GIL, so a second worker thread only adds tracing thrash
+    # (measured: 6 tenants through workers=2 halve a replica's delta
+    # rate vs serial) — cross-ontology concurrency comes from REPLICAS
+    sup = ReplicaSupervisor(
+        n_replicas,
+        spill_dir=spill,
+        extra_args=["--fast-path-min-concepts", "0", "--workers", "1"],
+    )
+    print(f"# booting {n_replicas} replica(s)…", file=sys.stderr)
+    t_boot = time.monotonic()
+    replicas = sup.start()
+    router = RouterApp(replicas, supervisor=sup)
+    router.start()
+    server = make_server(router, port=router_port)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    boot_s = time.monotonic() - t_boot
+    print(f"# fleet up at {url} in {boot_s:.1f}s", file=sys.stderr)
+
+    try:
+        base = ServeClient(url, timeout=300)
+        oids = [base.load(_mk_ontology(i))["id"] for i in range(clients)]
+        # settle: one warm delta per tenant so compile/trace cost sits
+        # in setup, not in the measured window
+        for i, oid in enumerate(oids):
+            base.delta(oid, f"SubClassOf(T{i}Warm T{i}C0)")
+
+        samples: list = []
+        failures: list = []
+        stop = threading.Event()
+        workers = [
+            ClientWorker(
+                i,
+                ServeClient(url, timeout=300, retries=2, backoff_s=0.1),
+                oids[i],
+                stop,
+                samples,
+                failures,
+            )
+            for i in range(clients)
+        ]
+        t0 = time.monotonic()
+        for w in workers:
+            w.start()
+
+        migration = None
+        if migrate_under_load and n_replicas >= 2:
+            time.sleep(duration_s / 2)
+            migration = _migrate_under_load(
+                router, base, workers[0], spill_root
+            )
+        deadline = t0 + duration_s
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+        stop.set()
+        for w in workers:
+            w.join(timeout=300)
+        wall_s = time.monotonic() - t0
+
+        by_op: dict = {}
+        for op, dt, ok in samples:
+            by_op.setdefault(op, []).append(dt)
+        lat = {}
+        for op, vals in sorted(by_op.items()):
+            vals.sort()
+            lat[op] = {
+                "n": len(vals),
+                "p50_ms": round(1e3 * _pct(vals, 0.50), 2),
+                "p99_ms": round(1e3 * _pct(vals, 0.99), 2),
+                "mean_ms": round(1e3 * statistics.fmean(vals), 2),
+            }
+        n_classify = len(by_op.get("classify", ()))
+        fleet_counters = {
+            short: router.metrics.counter_value(f"distel_fleet_{name}")
+            for short, name in (
+                ("migrations", "migrations_total"),
+                ("migration_failures", "migration_failures_total"),
+                ("ejections", "ejections_total"),
+                ("recoveries", "recoveries_total"),
+            )
+        }
+        fleet_counters["proxy_errors"] = router.metrics.counter_value(
+            "distel_router_proxy_errors_total"
+        )
+        rec = {
+            "scenario": label or f"scale-x{n_replicas}",
+            "replicas": n_replicas,
+            "clients": clients,
+            "wall_s": round(wall_s, 2),
+            "boot_s": round(boot_s, 2),
+            "requests": len(samples),
+            "failed_requests": len(failures),
+            "failures": failures[:10],
+            "classify_ops": n_classify,
+            "classify_throughput_ops_s": round(n_classify / wall_s, 2),
+            "latency": lat,
+            "fleet": fleet_counters,
+            "migration": migration,
+        }
+        return rec
+    finally:
+        stop_err = None
+        try:
+            router.close()
+        except Exception as e:  # noqa: BLE001
+            stop_err = e
+        server.shutdown()
+        server.server_close()
+        sup.stop(graceful=True)
+        if stop_err is not None:
+            print(f"# router close: {stop_err!r}", file=sys.stderr)
+
+
+def _migrate_under_load(router, client, worker, spill_root) -> dict:
+    """Live-migrate worker 0's ontology mid-run: quiesce ITS writes
+    (reads and every other tenant keep hammering), snapshot the
+    taxonomy, move the closure, snapshot again, resume.  The fleet
+    contract: zero failed requests anywhere and byte-identical taxonomy
+    documents across the move."""
+    oid = worker.oid
+    worker.pause_writes.set()
+    if not worker.writes_quiesced.wait(timeout=60):
+        worker.pause_writes.clear()
+        return {"ok": False, "error": "writer never quiesced"}
+    # one straggler write may still be in flight: the router's own
+    # migration drain handles it; the taxonomy snapshot below rides the
+    # same lane so it observes the settled closure
+    src = router.table.lookup(oid).rid
+    before = json.dumps(client.taxonomy(oid), sort_keys=True)
+    t0 = time.monotonic()
+    try:
+        rec = router.migrate(oid)
+    except Exception as e:  # noqa: BLE001
+        worker.pause_writes.clear()
+        return {"ok": False, "error": repr(e), "from": src}
+    after = json.dumps(client.taxonomy(oid), sort_keys=True)
+    worker.pause_writes.clear()
+    worker.writes_quiesced.clear()
+    out = {
+        "ok": True,
+        "byte_identical": before == after,
+        "from": rec["from"],
+        "to": rec["to"],
+        "migrate_wall_s": round(time.monotonic() - t0, 3),
+        "spill_restore_wall_s": rec["wall_s"],
+    }
+    if before != after:
+        out["diff"] = _tax_diff(json.loads(before), json.loads(after))
+        dump = os.path.join(spill_root, "migration_mismatch.json")
+        with open(dump, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "before": json.loads(before),
+                    "after": json.loads(after),
+                    # the acked write stream: replaying it on a fresh
+                    # classifier adjudicates WHICH side under-derives
+                    "journal": router._journal_texts(oid),
+                },
+                f, indent=1,
+            )
+        out["dump"] = dump
+    return out
+
+
+def _tax_diff(a: dict, b: dict, limit: int = 8) -> list:
+    """First differing taxonomy entries — a broken byte-identity claim
+    must say WHERE, not just false."""
+    diffs = []
+    for section in sorted(set(a) | set(b)):
+        va, vb = a.get(section), b.get(section)
+        if va == vb:
+            continue
+        if isinstance(va, dict) and isinstance(vb, dict):
+            for k in sorted(set(va) | set(vb)):
+                if va.get(k) != vb.get(k):
+                    diffs.append(
+                        f"{section}[{k}]: {va.get(k)!r} -> {vb.get(k)!r}"
+                    )
+                    if len(diffs) >= limit:
+                        return diffs
+        else:
+            diffs.append(f"{section}: {va!r} -> {vb!r}")
+            if len(diffs) >= limit:
+                return diffs
+    return diffs
+
+
+def _parallel_capacity(burn_s: float = 1.5) -> float:
+    """Measured parallel speedup of 2 busy processes over 1 — the real
+    scaling ceiling of this host (container quotas, SMT siblings, and
+    noisy neighbors all hide behind ``nproc``; a 2-core box that burns
+    at 1.2x can never show 2x replica scaling, and the record should
+    say so)."""
+    import multiprocessing as mp
+
+    def burn(q):
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < burn_s:
+            x += 1
+        q.put(x)
+
+    def run(n):
+        q = mp.Queue()
+        ps = [mp.Process(target=burn, args=(q,)) for _ in range(n)]
+        for p in ps:
+            p.start()
+        total = sum(q.get() for _ in ps)
+        for p in ps:
+            p.join()
+        return total
+
+    solo = run(1)
+    return round(run(2) / max(solo, 1), 2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, nargs="+", default=[1, 2, 4],
+                    help="replica counts to sweep (one fleet per count)")
+    ap.add_argument("--clients", type=int, default=6,
+                    help="concurrent simulated tenants (one ontology "
+                         "each; lanes spread across replicas)")
+    ap.add_argument("--duration-s", type=float, default=20.0,
+                    help="measured window per scenario")
+    ap.add_argument("--migrate-under-load", action="store_true",
+                    help="live-migrate one ontology mid-run (replicas "
+                         ">= 2) and assert zero failures + "
+                         "byte-identical taxonomy")
+    ap.add_argument("--spill-dir", default=None,
+                    help="fleet spill root (default: a temp dir)")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON record here as well as stdout")
+    args = ap.parse_args(argv)
+
+    spill_root = args.spill_dir or tempfile.mkdtemp(prefix="distel-bench-")
+    scenarios = []
+    for n in args.replicas:
+        # the scaling sweep runs clean: the migration freeze/spill
+        # would otherwise depress whichever scenario hosts it
+        rec = run_scenario(
+            n,
+            clients=args.clients,
+            duration_s=args.duration_s,
+            spill_root=spill_root,
+            migrate_under_load=False,
+        )
+        print(json.dumps(rec), flush=True)
+        scenarios.append(rec)
+    if args.migrate_under_load:
+        n = max(max(args.replicas), 2)
+        rec = run_scenario(
+            n,
+            clients=args.clients,
+            duration_s=args.duration_s,
+            spill_root=spill_root,
+            migrate_under_load=True,
+            label=f"migrate-under-load-x{n}",
+        )
+        print(json.dumps(rec), flush=True)
+        scenarios.append(rec)
+
+    by_n = {
+        s["replicas"]: s
+        for s in scenarios
+        if s["scenario"].startswith("scale-")
+    }
+    scaling = {}
+    if 1 in by_n:
+        base = by_n[1]["classify_throughput_ops_s"] or 1e-9
+        for n, s in sorted(by_n.items()):
+            if n != 1:
+                scaling[f"x{n}_vs_x1"] = round(
+                    s["classify_throughput_ops_s"] / base, 2
+                )
+    doc = {
+        "bench": "bench_serve",
+        "metric": "aggregate_classify_throughput_ops_s",
+        "host": {
+            "cores": len(os.sched_getaffinity(0)),
+            "parallel_capacity_2proc_x": _parallel_capacity(),
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "note": (
+            "each replica is one Python process running jax CPU "
+            "programs inline (one GIL per process): throughput scaling "
+            "is ceilinged by host.parallel_capacity_2proc_x, the "
+            "MEASURED parallel speedup of 2 busy processes on this "
+            "host (nproc alone overstates shared/SMT hosts)"
+        ),
+        "scenarios": scenarios,
+        "scaling": scaling,
+        "zero_failed_requests": all(
+            s["failed_requests"] == 0 for s in scenarios
+        ),
+    }
+    out = json.dumps(doc, indent=2)
+    print(out)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -391,8 +391,7 @@ def cmd_serve(args) -> int:
         if args.memory_budget_mb is not None
         else None
     )
-    app = ServeApp(
-        cfg,
+    kw = dict(
         workers=args.workers,
         max_queue=args.max_queue,
         max_batch=args.max_batch,
@@ -402,11 +401,115 @@ def cmd_serve(args) -> int:
         fast_path_min_concepts=args.fast_path_min_concepts,
         warmup_paths=args.warmup,
     )
+    if args.replica_id:
+        # fleet worker: the same app plus the /fleet admin plane the
+        # router drives (load-with-id, migrate-out, adopt)
+        from distel_tpu.serve.fleet.replica import ReplicaApp
+
+        if not args.spill_dir:
+            print(
+                "--replica-id needs --spill-dir (the migration handoff "
+                "spills through it)",
+                file=sys.stderr,
+            )
+            return 2
+        app = ReplicaApp(cfg, replica_id=args.replica_id, **kw)
+    else:
+        app = ServeApp(cfg, **kw)
     spilled = serve_forever(app, args.host, args.port)
     print(
         json.dumps({"shutdown": "graceful", "spilled": spilled}),
         flush=True,
     )
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """Serve fleet: N shared-nothing replica processes (supervised)
+    behind the affinity/migration router — the horizontal scale-out of
+    ``serve`` (see distel_tpu/serve/fleet/)."""
+    import signal as _signal
+    import threading
+
+    from distel_tpu.serve.fleet.router import RouterApp
+    from distel_tpu.serve.fleet.supervisor import ReplicaSupervisor
+    from distel_tpu.serve.server import make_server
+
+    cfg = _load_cfg(args)
+    n = args.replicas if args.replicas is not None else cfg.fleet_replicas
+    extra = []
+    for flag, val in (
+        ("--config", args.config),
+        ("--workers", args.workers),
+        ("--max-queue", args.max_queue),
+        ("--max-batch", args.max_batch),
+        ("--deadline-s", args.deadline_s),
+        ("--memory-budget-mb", args.memory_budget_mb),
+        ("--fast-path-min-concepts", args.fast_path_min_concepts),
+    ):
+        if val is not None:
+            extra += [flag, str(val)]
+    if args.warmup:
+        extra += ["--warmup", *args.warmup]
+    sup = ReplicaSupervisor(
+        n, spill_dir=args.spill_dir, extra_args=extra
+    )
+    router = None
+    try:
+        replicas = sup.start()
+        router = RouterApp(
+            replicas,
+            supervisor=sup,
+            depth_divergence=(
+                args.depth_divergence
+                if args.depth_divergence is not None
+                else cfg.fleet_depth_divergence
+            ),
+            heartbeat_interval_s=cfg.fleet_heartbeat_interval_s,
+            eject_failures=cfg.fleet_eject_failures,
+            rebalance_interval_s=cfg.fleet_rebalance_interval_s,
+        )
+        router.start()
+        server = make_server(router, args.host, args.port)
+    except Exception as e:
+        # a failed router bind (port taken) or construction must not
+        # orphan N live replica subprocesses
+        if router is not None:
+            router.close()
+        sup.stop(graceful=False)
+        print(f"fleet startup failed: {e}", file=sys.stderr)
+        return 1
+    bound = server.server_address[1]
+    print(
+        json.dumps(
+            {
+                "serving": True,
+                "role": "fleet-router",
+                "host": args.host,
+                "port": bound,
+                "replicas": [
+                    {"id": rid, "url": url} for rid, url in replicas
+                ],
+                "spill_dir": args.spill_dir,
+            }
+        ),
+        flush=True,
+    )
+
+    def _drain(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    prev_term = _signal.signal(_signal.SIGTERM, _drain)
+    prev_int = _signal.signal(_signal.SIGINT, _drain)
+    try:
+        server.serve_forever()
+    finally:
+        _signal.signal(_signal.SIGTERM, prev_term)
+        _signal.signal(_signal.SIGINT, prev_int)
+        server.server_close()
+        router.close()
+        sup.stop(graceful=True)
+    print(json.dumps({"shutdown": "graceful", "replicas": n}), flush=True)
     return 0
 
 
@@ -503,7 +606,53 @@ def main(argv=None) -> int:
                          "background thread precompiles at startup "
                          "(loads in a warmed bucket skip XLA; watch "
                          "distel_warmup_done on /metrics)")
+    sv.add_argument("--replica-id", default=None,
+                    help="run as a FLEET REPLICA under this id: adds "
+                         "the /fleet admin plane (load-with-id, "
+                         "migrate-out, adopt) the router drives; "
+                         "requires --spill-dir")
     sv.set_defaults(fn=cmd_serve)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="serve fleet: router + N supervised shared-nothing "
+             "replica processes (affinity placement, live migration, "
+             "queue-depth rebalance)",
+    )
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8080,
+                    help="router port; 0 binds ephemerally (printed "
+                         "at startup)")
+    fl.add_argument("--replicas", type=int, default=None,
+                    help="replica process count (default: config "
+                         "fleet.replicas, 2)")
+    fl.add_argument("--spill-dir", required=True,
+                    help="shared snapshot directory — the migration "
+                         "handoff and graceful shutdown spill through "
+                         "it; every replica mounts the same path")
+    fl.add_argument("--depth-divergence", type=int, default=None,
+                    help="queue-depth gap (hot − cool) that triggers a "
+                         "rebalance migration (default: config, 8)")
+    fl.add_argument("--config", help="properties/config file "
+                                     "(fleet.* knobs + replica config)")
+    fl.add_argument("--workers", type=int, default=None,
+                    help="scheduler workers per replica")
+    fl.add_argument("--max-queue", type=int, default=None,
+                    help="per-replica admission queue bound")
+    fl.add_argument("--max-batch", type=int, default=None,
+                    help="per-replica delta batch bound")
+    fl.add_argument("--deadline-s", type=float, default=None,
+                    help="per-replica default request deadline")
+    fl.add_argument("--memory-budget-mb", type=float, default=None,
+                    help="per-replica resident-closure budget")
+    fl.add_argument("--fast-path-min-concepts", type=int, default=None,
+                    help="per-replica delta fast-path cutoff override")
+    fl.add_argument("--warmup", nargs="*", default=None,
+                    metavar="ONTOLOGY",
+                    help="sample corpora every replica precompiles at "
+                         "startup (persistent-cache shared: the first "
+                         "replica compiles, the rest deserialize)")
+    fl.set_defaults(fn=cmd_fleet)
 
     w = sub.add_parser(
         "warmup",

@@ -140,6 +140,21 @@ class ClassifierConfig:
     pipeline: bool = True
     #: maximum speculatively in-flight observed rounds (1 = synchronous)
     pipeline_depth: int = 2
+    #: serve fleet (``serve/fleet/``): replica processes behind the
+    #: router — shared-nothing scale-out of the serve plane (the
+    #: reference's NODES_LIST, but processes on one host instead of
+    #: Redis nodes)
+    fleet_replicas: int = 2
+    #: queue-depth divergence (hot − cool) that triggers a live
+    #: ontology migration toward the cooler replica
+    fleet_depth_divergence: int = 8
+    #: router heartbeat period against each replica's /healthz
+    fleet_heartbeat_interval_s: float = 1.0
+    #: consecutive heartbeat failures before a replica is ejected (and
+    #: respawned when a supervisor is attached)
+    fleet_eject_failures: int = 3
+    #: rebalance sweep period (each sweep migrates at most one ontology)
+    fleet_rebalance_interval_s: float = 2.0
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -206,6 +221,20 @@ class ClassifierConfig:
             cfg.pipeline = raw["pipeline.enable"].lower() == "true"
         if "pipeline.depth" in raw:
             cfg.pipeline_depth = int(raw["pipeline.depth"])
+        if "fleet.replicas" in raw:
+            cfg.fleet_replicas = int(raw["fleet.replicas"])
+        if "fleet.depth.divergence" in raw:
+            cfg.fleet_depth_divergence = int(raw["fleet.depth.divergence"])
+        if "fleet.heartbeat.interval_s" in raw:
+            cfg.fleet_heartbeat_interval_s = float(
+                raw["fleet.heartbeat.interval_s"]
+            )
+        if "fleet.eject.failures" in raw:
+            cfg.fleet_eject_failures = int(raw["fleet.eject.failures"])
+        if "fleet.rebalance.interval_s" in raw:
+            cfg.fleet_rebalance_interval_s = float(
+                raw["fleet.rebalance.interval_s"]
+            )
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v

@@ -19,9 +19,16 @@ Layout::
                   registry/scheduler/instrumentation signals
     server.py     ThreadingHTTPServer app: the /v1 endpoints, /healthz,
                   /metrics, graceful SIGTERM shutdown with final spill
-    client.py     tiny stdlib client (urllib) used by the tests
+    client.py     tiny stdlib client (urllib) used by the tests, with
+                  opt-in jittered retry/backoff honoring Retry-After
+    fleet/        horizontal scale-out: router + shared-nothing replica
+                  processes — affinity placement, live ontology
+                  migration over the registry's spill/restore wire,
+                  heartbeat eject-and-respawn, queue-depth rebalance
 
-Entry point: ``python -m distel_tpu.cli serve --port 8080``.
+Entry points: ``python -m distel_tpu.cli serve --port 8080`` (one
+process) and ``python -m distel_tpu.cli fleet --replicas 4
+--spill-dir /var/tmp/distel-spill`` (router + replicas).
 """
 
 from distel_tpu.serve.registry import OntologyRegistry

@@ -11,11 +11,26 @@ Used by the tests and handy from a REPL::
 Non-2xx responses raise :class:`ServeError` carrying the HTTP status,
 the parsed error body, and the response headers (tests assert on 429's
 ``Retry-After``).
+
+Opt-in retry (``retries > 0``): 429/503 answers — admission refused,
+deadline passed, a fleet migration hold outlasted — are retried with
+jittered exponential backoff, honoring a ``Retry-After`` header when the
+server sent one; transport-level failures (connection refused/reset —
+the window where the fleet router is failing a replica over) retry the
+same way.  The serve plane's write ops are safe to re-send under this
+policy: a 429 was refused at admission, EL+ deltas are monotone
+(re-applying an increment that did land is the identity), and queries
+are reads.  The one caveat is ``load`` after a 503-deadline or a torn
+connection: the abandoned attempt may still complete server-side under
+its own id — a leaked resident ontology, never a wrong answer (callers
+that cannot tolerate the leak keep ``retries=0`` for loads).
 """
 
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 from typing import Optional
@@ -32,14 +47,76 @@ class ServeError(Exception):
         self.headers = dict(headers or {})
 
 
+#: statuses the serve plane uses for "not admitted — try again":
+#: queue-full 429, deadline/draining/migration-hold 503
+RETRYABLE_STATUSES = (429, 503)
+
+
 class ServeClient:
-    def __init__(self, base_url: str, timeout: float = 600.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 600.0,
+        *,
+        retries: int = 0,
+        backoff_s: float = 0.25,
+        max_backoff_s: float = 10.0,
+    ):
+        """``retries=0`` (default) preserves the raise-on-429/503
+        behavior; ``retries=N`` re-sends up to N times with jittered
+        exponential backoff (base ``backoff_s``, capped at
+        ``max_backoff_s``), preferring the server's ``Retry-After``."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
 
     # ------------------------------------------------------------- http
 
+    def _delay(self, attempt: int, retry_after: Optional[str]) -> float:
+        if retry_after:
+            try:
+                return min(float(retry_after), self.max_backoff_s)
+            except ValueError:
+                pass
+        # full jitter: herd-of-clients backoff must decorrelate, or
+        # every rejected client re-arrives in the same tick it left
+        ceiling = min(
+            self.backoff_s * (2 ** attempt), self.max_backoff_s
+        )
+        return random.uniform(0, ceiling)
+
     def _request(
+        self,
+        method: str,
+        path: str,
+        doc: Optional[dict] = None,
+        deadline_s: Optional[float] = None,
+        retry_statuses=RETRYABLE_STATUSES,
+    ):
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(method, path, doc, deadline_s)
+            except ServeError as e:
+                if (
+                    attempt >= self.retries
+                    or e.status not in retry_statuses
+                ):
+                    raise
+                delay = self._delay(
+                    attempt, e.headers.get("Retry-After")
+                )
+            except urllib.error.URLError:
+                # connection refused/reset: the router-failover window
+                if attempt >= self.retries:
+                    raise
+                delay = self._delay(attempt, None)
+            attempt += 1
+            time.sleep(delay)
+
+    def _request_once(
         self,
         method: str,
         path: str,

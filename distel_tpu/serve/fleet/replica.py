@@ -1,0 +1,135 @@
+"""Fleet replica: a :class:`~distel_tpu.serve.server.ServeApp` with the
+/fleet admin plane the router drives.
+
+Admin endpoints (router-only — a fleet deployment firewalls them from
+clients the same way the reference keeps Redis off the public net)::
+
+    POST /fleet/load      {"id": ..., "text": ...}   load under a
+                          ROUTER-minted id (fleet-wide uniqueness is the
+                          router's job; replica-local new_id would
+                          collide across shared-nothing processes)
+    POST /fleet/migrate   {"id": ...}                migrate-out: spill
+                          the closure, deregister, return the handoff
+                          record {"id","texts","spill"}
+    POST /fleet/adopt     {"id","texts","spill","warm"}  migrate-in:
+                          register from a peer's handoff (restore from
+                          the spill — byte-identical answers) or from
+                          texts alone (journal-replay crash recovery)
+
+All three ride the scheduler's per-ontology lane, so a migrate-out
+serializes after every previously admitted request for that ontology —
+the spilled closure is exactly the state those requests produced, and
+nothing in flight is dropped.  ``/healthz`` additionally reports the
+replica id and the resident ontology ids (the router's placement
+recovery reads them after a respawn).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from distel_tpu.serve.server import HTTPError, ServeApp, _dumps, _json_doc
+
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+_FLEET_ROUTES = (
+    ("POST", re.compile(r"^/fleet/load/?$"), "fleet_load",
+     "/fleet/load"),
+    ("POST", re.compile(r"^/fleet/migrate/?$"), "fleet_migrate",
+     "/fleet/migrate"),
+    ("POST", re.compile(r"^/fleet/adopt/?$"), "fleet_adopt",
+     "/fleet/adopt"),
+)
+
+
+class ReplicaApp(ServeApp):
+    ROUTES = _FLEET_ROUTES + ServeApp.ROUTES
+
+    def __init__(self, *args, replica_id: str = "r0", **kw):
+        super().__init__(*args, **kw)
+        self.replica_id = replica_id
+        self.metrics.describe(
+            "distel_registry_exports_total",
+            "ontologies migrated out (spill + deregister)",
+        )
+        self.metrics.describe(
+            "distel_registry_adoptions_total",
+            "ontologies migrated in (adopt from a peer's handoff)",
+        )
+
+    # ---------------------------------------------------- executor plane
+
+    def _execute(self, key: str, kind: str, payloads: List):
+        if kind == "migrate":
+            rec = self.registry.export(key)
+            # the per-increment taxonomy cache must leave with the
+            # closure — a re-adopted id would otherwise answer from the
+            # departed ontology's projection
+            self._tax_cache.pop(key, None)
+            return rec
+        if kind == "adopt":
+            doc = payloads[0]
+            try:
+                return self.registry.adopt(
+                    key,
+                    doc["texts"],
+                    spill_path=doc.get("spill"),
+                    warm=bool(doc.get("warm", True)),
+                )
+            except ValueError as e:
+                if "already loaded" in str(e):
+                    # 409, not 500: the router treats "the destination
+                    # already holds this id" as a committed handoff
+                    # (recovery/migration retry races land here)
+                    raise HTTPError(409, str(e))
+                raise
+        return super()._execute(key, kind, payloads)
+
+    # -------------------------------------------------------- HTTP plane
+
+    @staticmethod
+    def _fleet_id(doc: dict) -> str:
+        oid = doc.get("id")
+        if not isinstance(oid, str) or not _ID_RE.match(oid):
+            raise HTTPError(400, "body needs a well-formed \"id\"")
+        return oid
+
+    def _ep_fleet_load(self, *, query, body, deadline_s):
+        doc = _json_doc(body)
+        oid = self._fleet_id(doc)
+        text = doc.get("text")
+        if not isinstance(text, str) or not text.strip():
+            raise HTTPError(400, 'body must be {"id": ..., "text": ...}')
+        rec = self._schedule(oid, "load", text, deadline_s)
+        return 201, "application/json", _dumps(rec)
+
+    def _ep_fleet_migrate(self, *, query, body, deadline_s):
+        doc = _json_doc(body)
+        oid = self._fleet_id(doc)
+        rec = self._schedule(oid, "migrate", None, deadline_s)
+        return 200, "application/json", _dumps(rec)
+
+    def _ep_fleet_adopt(self, *, query, body, deadline_s):
+        doc = _json_doc(body)
+        oid = self._fleet_id(doc)
+        texts = doc.get("texts")
+        if (
+            not isinstance(texts, list)
+            or not texts
+            or not all(isinstance(t, str) for t in texts)
+        ):
+            raise HTTPError(400, 'body needs "texts": [str, ...]')
+        rec = self._schedule(oid, "adopt", doc, deadline_s)
+        return 200, "application/json", _dumps(rec)
+
+    def _ep_healthz(self, *, query, body, deadline_s):
+        status, ctype, payload = super()._ep_healthz(
+            query=query, body=body, deadline_s=deadline_s
+        )
+        import json
+
+        doc = json.loads(payload)
+        doc["replica_id"] = self.replica_id
+        doc["ontology_ids"] = self.registry.ids()
+        return status, ctype, _dumps(doc)

@@ -1,0 +1,680 @@
+"""The fleet router: the one address clients talk to.
+
+A thin, state-light tier in front of N shared-nothing replica processes
+(the jax analog of the reference's cluster config + work stealer):
+
+* **affinity placement** — every ontology pins to one replica (its warm
+  bucket programs and device-resident closure live there); new loads
+  land on the least-loaded healthy replica and the router mints the
+  fleet-wide ids (replica-local counters would collide);
+* **live migration** — admin- or rebalance-triggered: the router holds
+  new requests for the ontology, waits out the in-flight ones, drives
+  the source replica's ``/fleet/migrate`` (spill via the registry's
+  checkpoint ``.npz`` wire) and the target's ``/fleet/adopt`` (restore),
+  then releases the held requests at the new placement.  No request is
+  dropped and answers are byte-identical regardless of placement;
+* **health / eject-and-respawn** — a heartbeat thread polls every
+  replica's ``/healthz``; past ``eject_failures`` consecutive misses the
+  replica is ejected, the supervisor (when attached) respawns it, and
+  the stranded ontologies are re-placed onto healthy replicas by
+  replaying the router's text journal (the crash path has no spill to
+  restore from — monotone EL+ makes the replayed closure identical);
+* **queue-depth rebalance** — when one replica's scheduler depth
+  diverges from the coolest replica's past ``depth_divergence``, the
+  rebalance thread migrates the hot replica's least-recently-touched
+  ontology to the cool one (work following state, the work-stealing
+  analog);
+* **aggregated /metrics** — every replica's page re-exported under a
+  ``replica="<rid>"`` label next to the router's own counters.
+
+The router holds no closure state: only the placement table and the
+append-only text journal (what the reference keeps in its cluster
+config + the axiom store).  It reuses :func:`serve.server.make_server`
+— ``RouterApp`` satisfies the same ``dispatch``/``metrics`` surface as
+``ServeApp``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from distel_tpu.serve.fleet.placement import (
+    NoHealthyReplica,
+    PlacementTable,
+    ReplicaState,
+)
+from distel_tpu.serve.metrics import Metrics, aggregate_expositions
+from distel_tpu.serve.server import (
+    HTTPError,
+    _dumps,
+    _json_doc,
+    endpoint_label,
+    match_route,
+)
+
+_ROUTES = (
+    ("POST", re.compile(r"^/v1/ontologies/?$"), "load",
+     "/v1/ontologies"),
+    ("POST", re.compile(r"^/v1/ontologies/([^/]+)/deltas/?$"), "delta",
+     "/v1/ontologies/{id}/deltas"),
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/subsumers/?$"),
+     "proxy", "/v1/ontologies/{id}/subsumers"),
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/taxonomy/?$"),
+     "proxy", "/v1/ontologies/{id}/taxonomy"),
+    ("GET", re.compile(r"^/healthz/?$"), "healthz", "/healthz"),
+    ("GET", re.compile(r"^/metrics/?$"), "metrics", "/metrics"),
+    ("POST", re.compile(r"^/fleet/migrate/?$"), "migrate",
+     "/fleet/migrate"),
+    ("GET", re.compile(r"^/fleet/status/?$"), "status", "/fleet/status"),
+)
+
+
+class RouterApp:
+    #: per-request series names the shared HTTP handler records under —
+    #: distinct from the replica families the aggregated /metrics
+    #: re-exports, so one scrape never sees a family twice
+    REQUEST_METRIC = "distel_router_requests_total"
+    REQUEST_SECONDS_METRIC = "distel_router_request_seconds"
+
+    def __init__(
+        self,
+        replicas: List[Tuple[str, str]],
+        *,
+        supervisor=None,
+        depth_divergence: int = 8,
+        heartbeat_interval_s: float = 1.0,
+        heartbeat_probe_timeout_s: float = 5.0,
+        eject_failures: int = 3,
+        rebalance_interval_s: float = 2.0,
+        migration_hold_timeout_s: float = 120.0,
+        proxy_timeout_s: float = 600.0,
+    ):
+        """``replicas``: ``[(rid, base_url), ...]`` — a static fleet
+        (tests, external process manager); with a ``supervisor``
+        (:class:`~distel_tpu.serve.fleet.supervisor.ReplicaSupervisor`)
+        ejected replicas are respawned and re-registered."""
+        self.supervisor = supervisor
+        self.table = PlacementTable(depth_divergence=depth_divergence)
+        for rid, url in replicas:
+            self.table.add_replica(rid, url)
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.heartbeat_probe_timeout_s = heartbeat_probe_timeout_s
+        self.eject_failures = eject_failures
+        self.rebalance_interval_s = rebalance_interval_s
+        self.migration_hold_timeout_s = migration_hold_timeout_s
+        self.proxy_timeout_s = proxy_timeout_s
+        self.metrics = Metrics()
+        self.started = time.time()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        #: oid → applied texts, in order (load first) — the replay
+        #: source for crash recovery; appended only after the replica
+        #: acknowledged the write
+        self._journal: Dict[str, List[str]] = {}
+        self._journal_lock = threading.Lock()
+        # migration holds: requests for a migrating oid wait on the
+        # condition instead of racing the handoff
+        self._cv = threading.Condition()
+        self._inflight: Dict[str, int] = {}
+        self._migrating: set = set()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        for name, help_text in (
+            ("distel_router_requests_total",
+             "router requests by endpoint and code"),
+            ("distel_fleet_migrations_total",
+             "live ontology migrations completed"),
+            ("distel_fleet_migration_failures_total",
+             "migrations that failed and rolled back"),
+            ("distel_fleet_ejections_total",
+             "replicas ejected after consecutive heartbeat failures"),
+            ("distel_fleet_recoveries_total",
+             "ontologies re-placed by journal replay after an ejection"),
+            ("distel_router_proxy_errors_total",
+             "requests that failed against an unreachable replica"),
+        ):
+            self.metrics.describe(name, help_text)
+        self.metrics.describe(
+            "distel_fleet_replicas_healthy", "healthy replicas"
+        )
+        self.metrics.gauge_fn(
+            "distel_fleet_replicas_healthy",
+            lambda: len(self.table.healthy_replicas()),
+        )
+        self.metrics.describe(
+            "distel_fleet_ontologies", "ontologies placed on the fleet"
+        )
+        self.metrics.gauge_fn(
+            "distel_fleet_ontologies",
+            lambda: len(self.table.stats()["placement"]),
+        )
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Start the heartbeat + rebalance threads (separate from
+        construction so tests can drive the loops by hand)."""
+        for target, name in (
+            (self._heartbeat_loop, "distel-fleet-heartbeat"),
+            (self._rebalance_loop, "distel-fleet-rebalance"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in list(self._threads):
+            t.join(timeout=10)
+
+    # ------------------------------------------------------ id / journal
+
+    def _new_id(self) -> str:
+        with self._seq_lock:
+            self._seq += 1
+            return f"ont-{self._seq:04d}"
+
+    def _journal_append(self, oid: str, text: str) -> None:
+        with self._journal_lock:
+            self._journal.setdefault(oid, []).append(text)
+
+    def _journal_texts(self, oid: str) -> List[str]:
+        with self._journal_lock:
+            return list(self._journal.get(oid, ()))
+
+    # ----------------------------------------------------------- holds
+
+    def _enter(self, oid: str) -> None:
+        """Block while ``oid`` is migrating, then count this request
+        in-flight (the migration path waits for the count to drain)."""
+        deadline = time.monotonic() + self.migration_hold_timeout_s
+        with self._cv:
+            while oid in self._migrating:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._stop.is_set():
+                    raise HTTPError(
+                        503, f"migration of {oid!r} outlasted the hold",
+                        {"Retry-After": "1"},
+                    )
+                self._cv.wait(timeout=min(left, 1.0))
+            self._inflight[oid] = self._inflight.get(oid, 0) + 1
+
+    def _leave(self, oid: str) -> None:
+        with self._cv:
+            n = self._inflight.get(oid, 1) - 1
+            if n <= 0:
+                self._inflight.pop(oid, None)
+            else:
+                self._inflight[oid] = n
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------ proxy
+
+    def _forward(
+        self,
+        replica: ReplicaState,
+        method: str,
+        path: str,
+        body: Optional[bytes],
+        deadline_s: Optional[float],
+    ):
+        """One hop to a replica.  Non-2xx replica answers proxy through
+        verbatim (they are the contract: 429/503/404 mean what they
+        mean); transport failures mark the replica and answer 502."""
+        req = urllib.request.Request(
+            replica.url + path, data=body, method=method
+        )
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if deadline_s is not None:
+            req.add_header("X-Distel-Deadline-S", str(deadline_s))
+        timeout = (
+            min(self.proxy_timeout_s, deadline_s + 5.0)
+            if deadline_s is not None
+            else self.proxy_timeout_s
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return (
+                    resp.status,
+                    resp.headers.get("Content-Type", "application/json"),
+                    resp.read(),
+                )
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            raise HTTPError(
+                e.code,
+                _error_message(payload),
+                {k: v for k, v in e.headers.items()
+                 if k.lower() == "retry-after"},
+            )
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            replica.note_failure()
+            self.metrics.counter_inc("distel_router_proxy_errors_total")
+            raise HTTPError(
+                502, f"replica {replica.rid} unreachable: {e}"
+            )
+
+    # ------------------------------------------------------- HTTP plane
+
+    def _endpoint_label(self, path: str) -> str:
+        return endpoint_label(_ROUTES, path)
+
+    def dispatch(self, method: str, path: str, query: dict, body: bytes,
+                 deadline_s: Optional[float]):
+        name, groups = match_route(_ROUTES, method, path)
+        handler = getattr(self, f"_ep_{name}")
+        return handler(*groups, query=query, body=body,
+                       deadline_s=deadline_s, path=path)
+
+    def _ep_load(self, *, query, body, deadline_s, path):
+        doc = _json_doc(body)
+        text = doc.get("text")
+        if not isinstance(text, str) or not text.strip():
+            raise HTTPError(400, 'body must be {"text": "<axioms>"}')
+        oid = self._new_id()
+        try:
+            replica = self.table.place(oid)
+        except NoHealthyReplica as e:
+            raise HTTPError(503, str(e), {"Retry-After": "1"})
+        self._enter(oid)
+        try:
+            payload = json.dumps({"id": oid, "text": text}).encode("utf-8")
+            status, ctype, out = self._forward(
+                replica, "POST", "/fleet/load", payload, deadline_s
+            )
+        except BaseException:
+            self.table.drop(oid)
+            raise
+        finally:
+            self._leave(oid)
+        self._journal_append(oid, text)
+        return status, ctype, out
+
+    def _ep_delta(self, oid, *, query, body, deadline_s, path):
+        doc = _json_doc(body)
+        text = doc.get("text")
+        if not isinstance(text, str) or not text.strip():
+            raise HTTPError(400, 'body must be {"text": "<axioms>"}')
+        status, ctype, out = self._proxy_oid(
+            oid, "POST", path, body, deadline_s
+        )
+        self._journal_append(oid, text)
+        return status, ctype, out
+
+    def _ep_proxy(self, oid, *, query, body, deadline_s, path):
+        from urllib.parse import quote
+
+        qs = "&".join(
+            f"{k}={quote(str(v))}" for k, v in query.items()
+        )
+        full = path + ("?" + qs if qs else "")
+        return self._proxy_oid(oid, "GET", full, None, deadline_s)
+
+    def _proxy_oid(self, oid, method, path, body, deadline_s):
+        self._enter(oid)
+        try:
+            replica = self.table.lookup(oid)
+            if replica is None:
+                raise HTTPError(404, f"unknown ontology {oid!r}")
+            return self._forward(replica, method, path, body, deadline_s)
+        finally:
+            self._leave(oid)
+
+    def _ep_healthz(self, *, query, body, deadline_s, path):
+        stats = self.table.stats()
+        doc = {
+            "status": "ok" if self.table.healthy_replicas() else "degraded",
+            "role": "router",
+            "uptime_s": round(time.time() - self.started, 1),
+            "replicas": stats["replicas"],
+            "ontologies": stats["ontologies"],
+            "migrating": sorted(self._migrating),
+        }
+        return 200, "application/json", _dumps(doc)
+
+    def _ep_metrics(self, *, query, body, deadline_s, path):
+        # scrape replicas CONCURRENTLY with a short per-replica budget:
+        # a replica grinding an inline device program answers late, and
+        # serial 10 s waits would push the whole fleet scrape past a
+        # standard Prometheus scrape_timeout exactly when visibility
+        # matters most
+        from concurrent.futures import ThreadPoolExecutor
+
+        def scrape(st):
+            try:
+                req = urllib.request.Request(st.url + "/metrics")
+                with urllib.request.urlopen(req, timeout=3) as resp:
+                    return st.rid, resp.read().decode("utf-8")
+            except (urllib.error.URLError, OSError, TimeoutError):
+                return st.rid, None  # slow/dead: skip, don't kill scrape
+
+        live = self.table.healthy_replicas()
+        pages = {}
+        if live:
+            with ThreadPoolExecutor(max_workers=len(live)) as pool:
+                for rid, page in pool.map(scrape, live):
+                    if page is not None:
+                        pages[rid] = page
+        text = self.metrics.render() + aggregate_expositions(pages)
+        return 200, "text/plain; version=0.0.4", text.encode("utf-8")
+
+    def _ep_status(self, *, query, body, deadline_s, path):
+        with self._journal_lock:
+            journal = {o: len(t) for o, t in self._journal.items()}
+        doc = {**self.table.stats(), "journal_texts": journal}
+        return 200, "application/json", _dumps(doc)
+
+    def _ep_migrate(self, *, query, body, deadline_s, path):
+        doc = _json_doc(body)
+        oid = doc.get("id")
+        if not isinstance(oid, str) or not oid:
+            raise HTTPError(400, "body needs \"id\"")
+        dst = doc.get("to")
+        rec = self.migrate(oid, dst_rid=dst)
+        return 200, "application/json", _dumps(rec)
+
+    # -------------------------------------------------------- migration
+
+    def migrate(self, oid: str, dst_rid: Optional[str] = None) -> dict:
+        """Live-migrate one ontology.  Holds new requests, drains the
+        in-flight ones, spills at the source, adopts at the target,
+        re-pins, releases.  On an adopt failure the handoff record is
+        re-adopted at the source (the spill file survives either way),
+        so the ontology is never lost."""
+        t0 = time.monotonic()
+        with self._cv:
+            if oid in self._migrating:
+                raise HTTPError(409, f"{oid!r} is already migrating")
+            src = self.table.lookup(oid)
+            if src is None:
+                raise HTTPError(404, f"unknown ontology {oid!r}")
+            self._migrating.add(oid)
+        try:
+            # drain: every forwarded request for oid has returned
+            deadline = time.monotonic() + self.migration_hold_timeout_s
+            with self._cv:
+                while self._inflight.get(oid, 0) > 0:
+                    if time.monotonic() > deadline:
+                        raise HTTPError(
+                            503, f"in-flight requests for {oid!r} "
+                            "never drained"
+                        )
+                    self._cv.wait(timeout=1.0)
+            dst = self._pick_destination(src, dst_rid)
+            # source: spill + deregister (rides the oid's scheduler
+            # lane, so it serializes after everything already admitted)
+            try:
+                _, _, out = self._forward(
+                    src, "POST", "/fleet/migrate",
+                    json.dumps({"id": oid}).encode("utf-8"), None,
+                )
+            except HTTPError:
+                # a source that died under us: fall back to journal
+                # replay onto a healthy replica (we hold the oid)
+                if not src.healthy and self._replay_onto_healthy(oid):
+                    self.metrics.counter_inc(
+                        "distel_fleet_recoveries_total"
+                    )
+                    return {
+                        "id": oid,
+                        "from": src.rid,
+                        "to": self.table.lookup(oid).rid,
+                        "recovered": True,
+                        "wall_s": round(time.monotonic() - t0, 4),
+                    }
+                raise
+            handoff = json.loads(out)
+            adopt = json.dumps(
+                {
+                    "id": oid,
+                    "texts": handoff["texts"],
+                    "spill": handoff["spill"],
+                    "warm": True,
+                }
+            ).encode("utf-8")
+            try:
+                self._forward(dst, "POST", "/fleet/adopt", adopt, None)
+            except HTTPError as e:
+                if e.status == 409:
+                    # the destination already holds this id (a raced
+                    # recovery replay landed first): its copy answers
+                    # for the same acked corpus — commit to it and let
+                    # the exported spill age out
+                    pass
+                else:
+                    # roll back: the spill restores at the source just
+                    # as well — placement only commits on success
+                    self.metrics.counter_inc(
+                        "distel_fleet_migration_failures_total"
+                    )
+                    try:
+                        self._forward(
+                            src, "POST", "/fleet/adopt", adopt, None
+                        )
+                    except HTTPError as rb:
+                        # rollback refused too (src overloaded or gone):
+                        # the oid is deregistered EVERYWHERE while the
+                        # placement still points at src — journal
+                        # replay is the remaining sound copy (we hold
+                        # the oid's migration flag)
+                        if rb.status == 409:
+                            pass  # src still holds it after all
+                        elif self._replay_onto_healthy(oid):
+                            self.metrics.counter_inc(
+                                "distel_fleet_recoveries_total"
+                            )
+                            return {
+                                "id": oid,
+                                "from": src.rid,
+                                "to": self.table.lookup(oid).rid,
+                                "recovered": True,
+                                "wall_s": round(
+                                    time.monotonic() - t0, 4
+                                ),
+                            }
+                        else:
+                            raise
+                    raise
+            self.table.assign(oid, dst.rid)
+            self.metrics.counter_inc("distel_fleet_migrations_total")
+            wall_s = time.monotonic() - t0
+            self.metrics.observe("distel_fleet_migration_seconds", wall_s)
+            return {
+                "id": oid,
+                "from": src.rid,
+                "to": dst.rid,
+                "wall_s": round(wall_s, 4),
+            }
+        finally:
+            with self._cv:
+                self._migrating.discard(oid)
+                self._cv.notify_all()
+
+    def _pick_destination(
+        self, src: ReplicaState, dst_rid: Optional[str]
+    ) -> ReplicaState:
+        if dst_rid is not None:
+            try:
+                dst = self.table.replica(dst_rid)
+            except KeyError:
+                raise HTTPError(400, f"unknown replica {dst_rid!r}")
+            if not dst.healthy:
+                raise HTTPError(503, f"replica {dst_rid!r} is ejected")
+            if dst.rid == src.rid:
+                raise HTTPError(400, "source and destination coincide")
+            return dst
+        peers = [
+            r for r in self.table.healthy_replicas() if r.rid != src.rid
+        ]
+        if not peers:
+            raise HTTPError(503, "no healthy destination replica")
+        return min(peers, key=lambda r: (r.queue_depth, r.resident, r.rid))
+
+    # ----------------------------------------------- heartbeat / recovery
+
+    def heartbeat_once(self) -> None:
+        """One health sweep (the loop calls this; tests call it
+        directly).
+
+        Ejection distinguishes DEAD from BUSY: connection
+        refused/reset (nothing listening) ejects after
+        ``eject_failures`` consecutive misses, but probe TIMEOUTS
+        alone never do — a replica grinding a long inline device
+        program holds its GIL and answers /healthz late, and ejecting
+        (then killing) it would destroy healthy warm state and
+        un-acked work.  A truly wedged-but-listening process is
+        surfaced by the supervisor's process liveness instead."""
+        for st in self.table.replicas():
+            if not st.healthy:
+                continue
+            try:
+                req = urllib.request.Request(st.url + "/healthz")
+                with urllib.request.urlopen(
+                    req, timeout=self.heartbeat_probe_timeout_s
+                ) as resp:
+                    st.note_ok(json.loads(resp.read()))
+            except (TimeoutError, ValueError):
+                st.note_failure(timeout=True)
+            except urllib.error.URLError as e:
+                # urllib wraps socket.timeout in URLError.reason
+                soft = isinstance(e.reason, TimeoutError)
+                st.note_failure(timeout=soft)
+            except OSError:
+                st.note_failure()
+            dead_process = (
+                self.supervisor is not None
+                and not self.supervisor.alive(st.rid)
+            )
+            if (
+                st.consecutive_failures >= self.eject_failures
+                or (dead_process and (st.consecutive_failures
+                                      or st.consecutive_timeouts))
+            ):
+                self._eject(st)
+
+    def _eject(self, st: ReplicaState) -> None:
+        """Mark the replica out SYNCHRONOUSLY (no more placements or
+        double-ejects), then respawn + journal-replay recovery on a
+        worker thread — respawn waits out a jax import and a warm
+        adopt re-classifies, and the heartbeat sweep must keep
+        detecting OTHER replicas' failures meanwhile."""
+        stranded = self.table.mark_ejected(st.rid)
+        self.metrics.counter_inc("distel_fleet_ejections_total")
+
+        def _respawn_and_recover():
+            if self.supervisor is not None:
+                try:
+                    url = self.supervisor.respawn(st.rid)
+                    self.table.mark_respawned(st.rid, url)
+                except Exception:
+                    pass  # stays ejected; recovery still re-places
+            self._recover(stranded)
+
+        t = threading.Thread(
+            target=_respawn_and_recover,
+            name=f"distel-fleet-eject-{st.rid}",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
+    def _recover(self, stranded: List[str]) -> None:
+        """Re-place ontologies stranded by an ejection: replay the text
+        journal onto a healthy replica (there is no spill to restore —
+        the replica died unspilled; monotone EL+ re-derives the same
+        closure from the same texts)."""
+        for oid in stranded:
+            with self._cv:
+                if oid in self._migrating:
+                    # an in-flight migration owns this oid: it either
+                    # lands the state on a healthy replica or runs this
+                    # same replay fallback itself — a second concurrent
+                    # replay would race it for the placement
+                    continue
+                self._migrating.add(oid)
+                # requests already in flight against the dead replica
+                # will fail on their own; don't wait on them
+                self._inflight.pop(oid, None)
+            try:
+                if self._replay_onto_healthy(oid):
+                    self.metrics.counter_inc(
+                        "distel_fleet_recoveries_total"
+                    )
+            finally:
+                with self._cv:
+                    self._migrating.discard(oid)
+                    self._cv.notify_all()
+
+    def _replay_onto_healthy(self, oid: str) -> bool:
+        """Adopt ``oid`` onto the least-loaded healthy replica from the
+        router's text journal.  Caller holds the oid's migration flag.
+        Returns False (and drops the placement) only when no replica
+        can take it."""
+        texts = self._journal_texts(oid)
+        if not texts:
+            self.table.drop(oid)
+            return False
+        try:
+            dst = self.table.place(oid)
+        except NoHealthyReplica:
+            self.table.drop(oid)
+            return False
+        adopt = json.dumps(
+            {"id": oid, "texts": texts, "warm": True}
+        ).encode("utf-8")
+        try:
+            self._forward(dst, "POST", "/fleet/adopt", adopt, None)
+        except HTTPError as e:
+            if e.status != 409:  # 409: dst already holds it — commit
+                self.table.drop(oid)
+                return False
+        self.table.assign(oid, dst.rid)
+        return True
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self.heartbeat_once()
+            except Exception:
+                continue  # the sweep must outlive any one bad replica
+
+    # --------------------------------------------------------- rebalance
+
+    def rebalance_once(self) -> Optional[dict]:
+        """One rebalance decision+execution (loop calls this; tests and
+        bench drive it directly).  Returns the migration record when one
+        happened."""
+        proposal = self.table.propose_migration()
+        if proposal is None:
+            return None
+        oid, _src, dst = proposal
+        try:
+            return self.migrate(oid, dst_rid=dst)
+        except HTTPError:
+            return None  # racing admin migration / replica loss: skip
+
+    def _rebalance_loop(self) -> None:
+        while not self._stop.wait(self.rebalance_interval_s):
+            try:
+                self.rebalance_once()
+            except Exception:
+                continue
+
+
+def _error_message(payload: bytes) -> str:
+    try:
+        doc = json.loads(payload.decode("utf-8"))
+        if isinstance(doc, dict) and "error" in doc:
+            return str(doc["error"])
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        pass
+    return payload.decode("utf-8", "replace") or "replica error"

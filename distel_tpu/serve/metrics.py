@@ -12,6 +12,7 @@ evictions) without a dependency.  Rendered in text exposition format
 from __future__ import annotations
 
 import math
+import re
 import threading
 from typing import Callable, Dict, Optional, Tuple, Union
 
@@ -23,6 +24,76 @@ DEFAULT_BUCKETS = (
 )
 
 _LabelKey = Tuple[Tuple[str, str], ...]
+
+#: ``name{labels} value [timestamp]`` — the shape of one exposition
+#: sample line (labels optional)
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(.+)$")
+
+#: suffixes histogram/summary samples hang off their family name
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count", "_max")
+
+
+def relabel_sample(line: str, extra: str) -> str:
+    """Inject pre-formatted label pairs (``'replica="r0"'``) into one
+    sample line; comment/blank lines pass through unchanged."""
+    if not line or line.startswith("#"):
+        return line
+    m = _SAMPLE_RE.match(line)
+    if m is None:
+        return line
+    name, labels, value = m.groups()
+    if labels:
+        merged = labels[:-1] + "," + extra + "}"
+    else:
+        merged = "{" + extra + "}"
+    return f"{name}{merged} {value}"
+
+
+def aggregate_expositions(pages: Dict[str, str]) -> str:
+    """Merge replicas' ``/metrics`` pages into one exposition, every
+    sample relabeled with ``replica="<rid>"`` — the router's aggregated
+    view of a shared-nothing fleet.  ``pages``: rid → page text.  Same
+    metric family across replicas renders as ONE group (HELP/TYPE once,
+    first replica's wording wins) so the output stays parseable by a
+    single scrape."""
+    helps: Dict[str, list] = {}
+    samples: Dict[str, list] = {}
+    order: list = []
+    for rid in sorted(pages):
+        extra = f'replica="{rid}"'
+        families = set(helps)
+        for line in pages[rid].splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    fam = parts[2]
+                    families.add(fam)
+                    acc = helps.setdefault(fam, [])
+                    if not any(line.split(None, 2)[1] == kept.split(None, 2)[1]
+                               for kept in acc):
+                        acc.append(line)
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name = m.group(1)
+            fam = name
+            if name not in families:
+                for suf in _FAMILY_SUFFIXES:
+                    if name.endswith(suf) and name[: -len(suf)] in families:
+                        fam = name[: -len(suf)]
+                        break
+            if fam not in samples:
+                samples[fam] = []
+                order.append(fam)
+            samples[fam].append(relabel_sample(line, extra))
+    lines = []
+    for fam in order:
+        lines.extend(helps.get(fam, []))
+        lines.extend(samples[fam])
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def _labels_key(labels: Optional[Dict[str, str]]) -> _LabelKey:

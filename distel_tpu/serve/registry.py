@@ -109,6 +109,17 @@ class OntologyRegistry:
             raise UnknownOntology(oid)
         return entry
 
+    def _check_live(self, entry: _Entry) -> None:
+        """Re-check registration under ``entry.lock``: a writer that
+        fetched the entry and then lost the lock race to an
+        :meth:`export` must fail loudly instead of mutating a
+        deregistered zombie (the ack would never reach the migrated
+        copy).  The serve scheduler's per-ontology lane already
+        serializes these; this keeps the registry safe on its own."""
+        with self._lock:
+            if self._entries.get(entry.oid) is not entry:
+                raise UnknownOntology(entry.oid)
+
     def new_id(self) -> str:
         """Reserve an ontology id (the scheduler needs the key *before*
         the load executes, so per-key serialization covers the load
@@ -184,6 +195,7 @@ class OntologyRegistry:
 
         entry = self._entry(oid)
         with entry.lock:
+            self._check_live(entry)
             inc = self._resident(entry)
             text = "\n".join(texts)
             # parse FIRST (the common failure, and it mutates nothing),
@@ -211,9 +223,88 @@ class OntologyRegistry:
         serialization (queries ride the same lane as deltas)."""
         entry = self._entry(oid)
         with entry.lock:
+            self._check_live(entry)
             inc = self._resident(entry)
             entry.last_used = time.monotonic()
             return inc
+
+    # -------------------------------------------------- migration plane
+
+    def export(self, oid: str) -> dict:
+        """Migrate-out hook: spill the ontology's closure to
+        ``spill_dir`` (the checkpoint ``.npz`` wire form), deregister
+        the id, and return the handoff record a peer replica's
+        :meth:`adopt` consumes — ``{"id", "texts", "spill"}``.
+
+        Rides the scheduler's per-ontology lane like any other request,
+        so it serializes AFTER every previously admitted request for
+        this ontology: nothing in flight is dropped, and the spilled
+        closure is the one those requests produced."""
+        if not self.spill_dir:
+            raise ValueError("export needs a spill_dir to snapshot into")
+        entry = self._entry(oid)
+        with entry.lock:
+            # same zombie guard as the writers: two concurrent exports
+            # (an operator driving a replica's /fleet/migrate directly
+            # while the router rebalances the same oid) must not both
+            # return a handoff — the loser sees UnknownOntology
+            self._check_live(entry)
+            path = self._spill(entry)
+            texts = list(entry.texts)
+            with self._lock:
+                self._entries.pop(oid, None)
+        self._count("distel_registry_exports_total")
+        return {"id": oid, "texts": texts, "spill": path}
+
+    def adopt(
+        self,
+        oid: str,
+        texts: List[str],
+        spill_path: Optional[str] = None,
+        warm: bool = True,
+    ) -> dict:
+        """Migrate-in hook: register an ontology from a peer's
+        :meth:`export` record.  With a ``spill_path`` the closure
+        restores from the snapshot (frontend replay + warm-start — the
+        answers are byte-identical to the source replica's); without one
+        the texts re-classify from scratch (crash recovery: the router
+        replays its journal when a replica died without spilling).
+
+        ``warm=True`` restores eagerly so the handoff completes with a
+        resident classifier; ``warm=False`` defers to the first request
+        (the LRU lazy-restore path)."""
+        if not texts:
+            raise ValueError("adopt needs at least one ontology text")
+        with self._lock:
+            if oid in self._entries:
+                raise ValueError(f"ontology id already loaded: {oid}")
+            entry = self._entries[oid] = _Entry(oid)
+        try:
+            with entry.lock:
+                if spill_path is not None:
+                    entry.texts = list(texts)
+                    entry.spill_path = spill_path
+                    if warm:
+                        self._resident(entry)
+                else:
+                    inc = self._new_inc()
+                    inc.add_text("\n".join(texts))
+                    entry.inc = inc
+                    entry.texts = list(texts)
+                    entry.resident_bytes = _state_bytes(inc)
+                entry.last_used = time.monotonic()
+        except BaseException:
+            # a failed adopt must not leave a zombie id behind
+            with self._lock:
+                self._entries.pop(oid, None)
+            raise
+        self._count("distel_registry_adoptions_total")
+        self._maybe_evict(keep=oid)
+        return {
+            "id": oid,
+            "resident": entry.inc is not None,
+            "restored_from": spill_path,
+        }
 
     # ------------------------------------------------------ spill plane
 

@@ -44,7 +44,9 @@ from distel_tpu.serve.scheduler import (
 MAX_BODY_BYTES = 64 << 20
 
 #: (method, pattern, handler name, canonical metrics label) — the label
-#: is fixed per route so client-chosen URLs can never mint new series
+#: is fixed per route so client-chosen URLs can never mint new series.
+#: Subclasses (the fleet replica's admin plane) extend via
+#: ``ServeApp.ROUTES``.
 _ROUTES = (
     ("POST", re.compile(r"^/v1/ontologies/?$"), "load",
      "/v1/ontologies"),
@@ -59,17 +61,6 @@ _ROUTES = (
 )
 
 
-def _endpoint_label(path: str) -> str:
-    """Bounded-cardinality metrics label for a request path: a route's
-    canonical label, or the single bucket "unmatched" — raw 404 paths
-    (scanners, typos) must never become label values on a server whose
-    job is staying up."""
-    for _meth, pattern, _name, label in _ROUTES:
-        if pattern.match(path):
-            return label
-    return "unmatched"
-
-
 class HTTPError(Exception):
     def __init__(self, status: int, message: str, headers=None):
         super().__init__(message)
@@ -78,10 +69,42 @@ class HTTPError(Exception):
         self.headers = dict(headers or {})
 
 
+def match_route(routes, method: str, path: str):
+    """``(handler_name, path_groups)`` for the first matching route,
+    raising the canonical 405/404 — the one route matcher behind both
+    the serve app's and the fleet router's dispatch."""
+    for meth, pattern, name, _label in routes:
+        m = pattern.match(path)
+        if m is None:
+            continue
+        if meth != method:
+            raise HTTPError(405, f"{method} not allowed on {path}")
+        return name, m.groups()
+    raise HTTPError(404, f"no route for {method} {path}")
+
+
+def endpoint_label(routes, path: str) -> str:
+    """Bounded-cardinality metrics label for a request path: a route's
+    canonical label, or the single bucket "unmatched" — raw 404 paths
+    (scanners, typos) must never become label values on a server whose
+    job is staying up."""
+    for _meth, pattern, _name, label in routes:
+        if pattern.match(path):
+            return label
+    return "unmatched"
+
+
 class ServeApp:
     """Registry + scheduler + metrics behind the HTTP handlers; owns no
     sockets, so tests drive it in-process and ``make_server`` wraps it
     for real serving."""
+
+    #: route table — subclasses extend with their own entries (the
+    #: fleet replica prepends its /fleet admin plane)
+    ROUTES = _ROUTES
+
+    def _endpoint_label(self, path: str) -> str:
+        return endpoint_label(self.ROUTES, path)
 
     def __init__(
         self,
@@ -335,16 +358,10 @@ class ServeApp:
                  deadline_s: Optional[float]):
         """Route one request.  Returns ``(status, content_type, bytes)``;
         raises :class:`HTTPError` for client/overload errors."""
-        for meth, pattern, name, _label in _ROUTES:
-            m = pattern.match(path)
-            if m is None:
-                continue
-            if meth != method:
-                raise HTTPError(405, f"{method} not allowed on {path}")
-            handler = getattr(self, f"_ep_{name}")
-            return handler(*m.groups(), query=query, body=body,
-                           deadline_s=deadline_s)
-        raise HTTPError(404, f"no route for {method} {path}")
+        name, groups = match_route(self.ROUTES, method, path)
+        handler = getattr(self, f"_ep_{name}")
+        return handler(*groups, query=query, body=body,
+                       deadline_s=deadline_s)
 
     def _schedule(self, key: str, kind: str, payload,
                   deadline_s: Optional[float], batchable=False):
@@ -375,11 +392,7 @@ class ServeApp:
 
     @staticmethod
     def _json_text(body: bytes) -> str:
-        try:
-            doc = json.loads(body.decode("utf-8"))
-        except (UnicodeDecodeError, json.JSONDecodeError) as e:
-            raise HTTPError(400, f"invalid JSON body: {e}")
-        text = doc.get("text") if isinstance(doc, dict) else None
+        text = _json_doc(body).get("text")
         if not isinstance(text, str) or not text.strip():
             raise HTTPError(400, 'body must be {"text": "<axioms>"}')
         return text
@@ -434,6 +447,17 @@ class ServeApp:
 
 def _dumps(doc) -> bytes:
     return (json.dumps(doc) + "\n").encode("utf-8")
+
+
+def _json_doc(body: bytes) -> dict:
+    """Parse a JSON-object request body or raise the right 400."""
+    try:
+        doc = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise HTTPError(400, f"invalid JSON body: {e}")
+    if not isinstance(doc, dict):
+        raise HTTPError(400, "body must be a JSON object")
+    return doc
 
 
 def _make_handler(app: ServeApp):
@@ -503,13 +527,18 @@ def _make_handler(app: ServeApp):
                 except Exception:
                     pass
             finally:
-                endpoint = _endpoint_label(path)
+                endpoint = app._endpoint_label(path)
+                # the router overrides these so its own series never
+                # collide with the replica families it re-exports
                 app.metrics.counter_inc(
-                    "distel_requests_total",
+                    getattr(app, "REQUEST_METRIC", "distel_requests_total"),
                     {"endpoint": endpoint, "code": str(status)},
                 )
                 app.metrics.observe(
-                    "distel_request_seconds",
+                    getattr(
+                        app, "REQUEST_SECONDS_METRIC",
+                        "distel_request_seconds",
+                    ),
                     time.monotonic() - t0,
                     {"endpoint": endpoint},
                 )

@@ -1,0 +1,463 @@
+"""Serve-fleet tests: placement/rebalance policy, the router's
+affinity proxying over live in-process replicas, live migration
+(byte-identical taxonomy, zero dropped requests under concurrent load),
+heartbeat ejection with journal-replay recovery, aggregated /metrics,
+and the client's opt-in retry/backoff."""
+
+import contextlib
+import json
+import threading
+import time
+
+import pytest
+
+from distel_tpu.serve.client import ServeClient, ServeError
+from distel_tpu.serve.fleet.placement import (
+    NoHealthyReplica,
+    PlacementTable,
+)
+from distel_tpu.serve.fleet.replica import ReplicaApp
+from distel_tpu.serve.fleet.router import RouterApp
+from distel_tpu.serve.metrics import aggregate_expositions, relabel_sample
+from distel_tpu.serve.server import make_server
+
+BASE = """
+SubClassOf(A B)
+SubClassOf(B C)
+SubClassOf(C ObjectSomeValuesFrom(r D))
+SubClassOf(ObjectSomeValuesFrom(r D) E)
+SubClassOf(E F)
+"""
+
+DELTA = """
+SubClassOf(New0 A)
+SubClassOf(New0 ObjectSomeValuesFrom(r G))
+SubClassOf(G D)
+"""
+
+
+# --------------------------------------------------------------- fixtures
+
+
+@contextlib.contextmanager
+def fleet(tmp_path, n=2, **router_kw):
+    """An in-process fleet: n ReplicaApps on live HTTP servers behind a
+    RouterApp (threads, one shared jax runtime — the correctness rig;
+    bench_serve.py runs the real subprocess fleet)."""
+    spill = str(tmp_path / "spill")
+    apps, servers, replicas = [], [], []
+    for i in range(n):
+        app = ReplicaApp(
+            replica_id=f"r{i}", spill_dir=spill,
+            fast_path_min_concepts=0,
+        )
+        srv = make_server(app)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        apps.append(app)
+        servers.append(srv)
+        replicas.append(
+            (f"r{i}", f"http://127.0.0.1:{srv.server_address[1]}")
+        )
+    router = RouterApp(replicas, **router_kw)
+    rsrv = make_server(router)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    client = ServeClient(
+        f"http://127.0.0.1:{rsrv.server_address[1]}", timeout=300
+    )
+    try:
+        yield router, client, apps, servers
+    finally:
+        router.close()
+        for s in servers + [rsrv]:
+            s.shutdown()
+            s.server_close()
+        for a in apps:
+            a.close(final_spill=False)
+
+
+def _direct_taxonomy(texts):
+    from distel_tpu.core.incremental import IncrementalClassifier
+    from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    for t in texts:
+        inc.add_text(t)
+    return extract_taxonomy(inc.last_result)
+
+
+# ------------------------------------------------------ placement policy
+
+
+def test_placement_least_loaded_and_affinity():
+    t = PlacementTable(depth_divergence=4)
+    t.add_replica("r0", "http://a")
+    t.add_replica("r1", "http://b")
+    t.replica("r0").queue_depth = 3
+    first = t.place("o1")
+    assert first.rid == "r1"  # least queue depth wins
+    assert t.lookup("o1").rid == "r1"
+    # placement counts toward load immediately: with equal depths the
+    # resident tiebreak rotates a burst across replicas
+    t.replica("r0").queue_depth = 0
+    assert t.place("o2").rid == "r0"
+    assert t.place("o3").rid == "r0"  # ties break toward the low rid
+    assert t.place("o4").rid == "r1"  # r0 now carries more residents
+    assert sorted(t.ontologies_on("r1")) == ["o1", "o4"]
+    t.drop("o3")
+    assert t.lookup("o3") is None
+
+
+def test_placement_rebalance_proposal_and_ejection():
+    t = PlacementTable(depth_divergence=4)
+    t.add_replica("r0", "http://a")
+    t.add_replica("r1", "http://b")
+    t.assign("hot1", "r0")
+    time.sleep(0)  # tick ordering is internal, not wall-clock
+    t.assign("hot2", "r0")
+    t.lookup("hot1")  # hot2 is now least-recently-touched
+    assert t.propose_migration() is None  # no divergence yet
+    t.replica("r0").queue_depth = 9
+    prop = t.propose_migration()
+    assert prop == ("hot2", "r0", "r1")
+    # single healthy replica → nothing to propose
+    stranded = t.mark_ejected("r1")
+    assert stranded == []
+    assert t.propose_migration() is None
+    stranded = t.mark_ejected("r0")
+    assert sorted(stranded) == ["hot1", "hot2"]
+    with pytest.raises(NoHealthyReplica):
+        t.place("o9")
+    t.mark_respawned("r0", "http://a2")
+    assert t.place("o9").rid == "r0"
+    assert t.replica("r0").url == "http://a2"
+
+
+# --------------------------------------------------- metrics aggregation
+
+
+def test_relabel_and_aggregate_expositions():
+    assert (
+        relabel_sample('m_total{kind="x"} 2', 'replica="r0"')
+        == 'm_total{kind="x",replica="r0"} 2'
+    )
+    assert relabel_sample("m_total 2", 'replica="r1"') == (
+        'm_total{replica="r1"} 2'
+    )
+    assert relabel_sample("# TYPE m_total counter", "x") == (
+        "# TYPE m_total counter"
+    )
+    page = (
+        "# HELP lat_seconds latency\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="+Inf"} 3\n'
+        "lat_seconds_sum 0.5\n"
+        "lat_seconds_count 3\n"
+        "# TYPE up gauge\n"
+        "up 1\n"
+    )
+    out = aggregate_expositions({"r0": page, "r1": page})
+    # one family group: HELP/TYPE once, both replicas' samples under it
+    assert out.count("# TYPE lat_seconds histogram") == 1
+    assert 'lat_seconds_sum{replica="r0"} 0.5' in out
+    assert 'lat_seconds_sum{replica="r1"} 0.5' in out
+    assert 'lat_seconds_bucket{le="+Inf",replica="r1"} 3' in out
+    assert out.count("# TYPE up gauge") == 1
+    assert 'up{replica="r0"} 1' in out
+    # samples of one family stay contiguous under their TYPE line
+    type_at = out.index("# TYPE lat_seconds histogram")
+    gauge_at = out.index("# TYPE up gauge")
+    assert type_at < out.index('lat_seconds_sum{replica="r1"}') < gauge_at
+
+
+# ------------------------------------------------- router end to end
+
+
+def test_fleet_affinity_placement_and_parity(tmp_path):
+    onto_b = "SubClassOf(P Q)\nSubClassOf(Q S)\n"
+    with fleet(tmp_path, n=2) as (router, client, apps, servers):
+        oid_a = client.load(BASE)["id"]
+        oid_b = client.load(onto_b)["id"]
+        # affinity spread: two loads on an idle fleet land on distinct
+        # replicas (least-loaded with the resident tiebreak)
+        place = router.table.stats()["placement"]
+        assert sorted(place) == sorted([oid_a, oid_b])
+        assert place[oid_a] != place[oid_b]
+        # answers ride the pinned replica and match a direct classifier
+        got = client.subsumers(oid_a, "A")
+        assert got["subsumers"] == _direct_taxonomy([BASE]).subsumers["A"]
+        d = client.delta(oid_a, DELTA)
+        assert d["id"] == oid_a and d["path"] == "fast"
+        got = client.subsumers(oid_a, "New0")
+        want = _direct_taxonomy([BASE, DELTA]).subsumers["New0"]
+        assert got["subsumers"] == want
+        # unknown ontology is a clean 404 at the router
+        with pytest.raises(ServeError) as ei:
+            client.taxonomy("ont-9999")
+        assert ei.value.status == 404
+        # router health reports both replicas after a heartbeat
+        router.heartbeat_once()
+        h = client.healthz()
+        assert h["role"] == "router"
+        assert len(h["replicas"]) == 2
+        assert all(r["healthy"] for r in h["replicas"])
+
+
+def test_fleet_live_migration_byte_identical_under_load(tmp_path):
+    with fleet(tmp_path, n=2) as (router, client, apps, servers):
+        oid = client.load(BASE)["id"]
+        client.delta(oid, DELTA)
+        src = router.table.lookup(oid).rid
+        tax_before = json.dumps(client.taxonomy(oid), sort_keys=True)
+
+        # concurrent clients hammer the ontology THROUGH the migration;
+        # the router holds, never drops — zero failures, retries=0
+        failures, answers = [], []
+        stop = threading.Event()
+
+        def hammer(k):
+            i = 0
+            while not stop.is_set():
+                try:
+                    if k % 2:
+                        answers.append(
+                            client.taxonomy(oid)["parents"]["A"]
+                        )
+                    else:
+                        client.delta(
+                            oid, f"SubClassOf(Load{k}x{i} A)"
+                        )
+                    i += 1
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(k,)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        rec = router.migrate(oid)
+        assert rec["from"] == src and rec["to"] != src
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        assert failures == []
+        assert answers and all(a == ["B"] for a in answers)
+        # placement committed; the source replica no longer holds it
+        assert router.table.lookup(oid).rid == rec["to"]
+        src_app = apps[int(src[1:])]
+        assert oid not in src_app.registry.ids()
+
+        # the deltas applied mid-migration survived the move: replaying
+        # everything on a fresh classifier gives the same taxonomy
+        m = client.metrics_text()
+        assert "distel_fleet_migrations_total" in m
+        # a quiesced migration is byte-identical: migrate back with no
+        # load and compare the full taxonomy documents
+        tax_mid = json.dumps(client.taxonomy(oid), sort_keys=True)
+        router.migrate(oid)
+        tax_after = json.dumps(client.taxonomy(oid), sort_keys=True)
+        assert tax_mid == tax_after
+        assert json.loads(tax_after)["parents"]["A"] == (
+            json.loads(tax_before)["parents"]["A"]
+        )
+
+
+def test_fleet_migration_guards(tmp_path):
+    with fleet(tmp_path, n=2) as (router, client, apps, servers):
+        oid = client.load(BASE)["id"]
+        with pytest.raises(Exception) as ei:
+            router.migrate("ont-9999")
+        assert getattr(ei.value, "status", None) == 404
+        src = router.table.lookup(oid).rid
+        with pytest.raises(Exception) as ei:
+            router.migrate(oid, dst_rid=src)
+        assert getattr(ei.value, "status", None) == 400
+        with pytest.raises(Exception) as ei:
+            router.migrate(oid, dst_rid="r-nope")
+        assert getattr(ei.value, "status", None) == 400
+        # admin endpoint drives the same path
+        rec = client._request(
+            "POST", "/fleet/migrate", {"id": oid}
+        )
+        assert rec["from"] == src
+
+
+def test_fleet_ejection_recovers_by_journal_replay(tmp_path):
+    with fleet(
+        tmp_path, n=2, eject_failures=2
+    ) as (router, client, apps, servers):
+        oid = client.load(BASE)["id"]
+        client.delta(oid, DELTA)
+        rid = router.table.lookup(oid).rid
+        idx = int(rid[1:])
+        # kill the pinned replica's HTTP plane (crash, no spill)
+        servers[idx].shutdown()
+        servers[idx].server_close()
+        for _ in range(2):
+            router.heartbeat_once()
+        # ejected synchronously; recovery (journal replay) runs on a
+        # worker thread so the heartbeat keeps sweeping — poll it
+        assert not router.table.replica(rid).healthy
+        deadline = time.monotonic() + 120
+        while (
+            router.metrics.counter_value("distel_fleet_recoveries_total")
+            < 1
+        ):
+            assert time.monotonic() < deadline, "recovery never ran"
+            time.sleep(0.05)
+        survivor = router.table.lookup(oid)
+        assert survivor is not None and survivor.rid != rid
+        got = client.subsumers(oid, "New0")
+        want = _direct_taxonomy([BASE, DELTA]).subsumers["New0"]
+        assert got["subsumers"] == want
+        assert (
+            router.metrics.counter_value("distel_fleet_recoveries_total")
+            == 1
+        )
+        assert (
+            router.metrics.counter_value("distel_fleet_ejections_total")
+            == 1
+        )
+
+
+def test_fleet_rebalance_migrates_off_hot_replica(tmp_path):
+    with fleet(
+        tmp_path, n=2, depth_divergence=2
+    ) as (router, client, apps, servers):
+        oid_a = client.load(BASE)["id"]
+        rid = router.table.lookup(oid_a).rid
+        # fake a diverged queue: the pinned replica reads hot
+        router.table.replica(rid).queue_depth = 5
+        rec = router.rebalance_once()
+        assert rec is not None and rec["id"] == oid_a
+        assert router.table.lookup(oid_a).rid != rid
+        # balanced fleet: no further proposal
+        router.table.replica(rid).queue_depth = 0
+        assert router.rebalance_once() is None
+
+
+def test_fleet_aggregated_metrics_families(tmp_path):
+    with fleet(tmp_path, n=2) as (router, client, apps, servers):
+        client.load(BASE)
+        text = client.metrics_text()
+        # router families present, once
+        assert text.count("# TYPE distel_router_requests_total counter") == 1
+        assert "distel_fleet_replicas_healthy 2" in text
+        # replica families grouped: one TYPE line, per-replica samples
+        assert text.count("# TYPE distel_requests_total counter") == 1
+        assert 'replica="r0"' in text and 'replica="r1"' in text
+
+
+# ------------------------------------------------- client retry/backoff
+
+
+class _Flaky:
+    """Stdlib handler stub: N rejections, then success."""
+
+    def __init__(self, rejections, status=503, retry_after=None):
+        self.left = rejections
+        self.status = status
+        self.retry_after = retry_after
+        self.calls = 0
+
+    def app(self):
+        from http.server import BaseHTTPRequestHandler
+
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                stub.calls += 1
+                if stub.left > 0:
+                    stub.left -= 1
+                    body = b'{"error": "try later"}'
+                    self.send_response(stub.status)
+                    if stub.retry_after is not None:
+                        self.send_header(
+                            "Retry-After", stub.retry_after
+                        )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header(
+                        "Content-Type", "application/json"
+                    )
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = b'{"status": "ok"}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+        return H
+
+
+@contextlib.contextmanager
+def _flaky_server(stub):
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), stub.app())
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_client_retry_honors_retry_after_and_backoff():
+    stub = _Flaky(rejections=2, status=503, retry_after="0.05")
+    with _flaky_server(stub) as url:
+        c = ServeClient(url, timeout=10, retries=3, backoff_s=0.01)
+        t0 = time.monotonic()
+        assert c.healthz()["status"] == "ok"
+        # two Retry-After sleeps happened, bounded above by sanity
+        assert 0.1 <= time.monotonic() - t0 < 5
+        assert stub.calls == 3
+
+
+def test_client_retry_opt_in_and_exhaustion():
+    # default retries=0: first 429 surfaces immediately
+    stub = _Flaky(rejections=1, status=429)
+    with _flaky_server(stub) as url:
+        c = ServeClient(url, timeout=10)
+        with pytest.raises(ServeError) as ei:
+            c.healthz()
+        assert ei.value.status == 429
+        assert stub.calls == 1
+    # retries exhausted: the last rejection surfaces
+    stub = _Flaky(rejections=5, status=503)
+    with _flaky_server(stub) as url:
+        c = ServeClient(url, timeout=10, retries=2, backoff_s=0.01)
+        with pytest.raises(ServeError) as ei:
+            c.healthz()
+        assert ei.value.status == 503
+        assert stub.calls == 3  # 1 + 2 retries
+    # non-retryable statuses never retry
+    stub = _Flaky(rejections=1, status=404)
+    with _flaky_server(stub) as url:
+        c = ServeClient(url, timeout=10, retries=3, backoff_s=0.01)
+        with pytest.raises(ServeError) as ei:
+            c.healthz()
+        assert ei.value.status == 404
+        assert stub.calls == 1
+
+
+def test_client_retries_connection_errors():
+    # nothing listening: retries happen, then the URLError surfaces
+    import urllib.error
+
+    c = ServeClient(
+        "http://127.0.0.1:9", timeout=1, retries=1, backoff_s=0.01
+    )
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.URLError):
+        c.healthz()
+    assert time.monotonic() - t0 < 30

@@ -357,6 +357,25 @@ def test_config_from_properties(tmp_path):
     assert cfg.rule_backends == {"CR1": "tpu", "CR6": "cpu"}
 
 
+def test_config_fleet_knobs(tmp_path):
+    p = tmp_path / "fleet.properties"
+    p.write_text(
+        "fleet.replicas = 4\n"
+        "fleet.depth.divergence = 16\n"
+        "fleet.heartbeat.interval_s = 0.5\n"
+        "fleet.eject.failures = 5\n"
+        "fleet.rebalance.interval_s = 3.5\n"
+    )
+    cfg = ClassifierConfig.from_properties(str(p))
+    assert cfg.fleet_replicas == 4
+    assert cfg.fleet_depth_divergence == 16
+    assert cfg.fleet_heartbeat_interval_s == 0.5
+    assert cfg.fleet_eject_failures == 5
+    assert cfg.fleet_rebalance_interval_s == 3.5
+    # defaults survive an unrelated properties file
+    assert ClassifierConfig().fleet_replicas == 2
+
+
 def test_config_reference_spellings(tmp_path):
     p = tmp_path / "ShardInfo.properties"
     p.write_text("NODES_LIST = nimbus2:6379,nimbus3:6379,nimbus4:6379\nchunk.size = 500\n")

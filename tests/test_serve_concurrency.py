@@ -1,0 +1,260 @@
+"""Genuinely concurrent serve-plane coverage (ISSUE 6 satellite):
+threads hammering one ontology while the registry spills/restores/
+migrates under a starvation-level memory budget, a registry-level
+export race against live writers, and scheduler queue-full behavior
+under a concurrent client herd — the paths test_serve.py only walks
+single-threaded."""
+
+import threading
+import time
+
+import pytest
+
+from distel_tpu.serve.registry import OntologyRegistry, UnknownOntology
+from distel_tpu.serve.scheduler import QueueFull, RequestScheduler
+from distel_tpu.serve.server import ServeApp, make_server
+from distel_tpu.serve.client import ServeClient
+
+BASE = """
+SubClassOf(A B)
+SubClassOf(B C)
+SubClassOf(C ObjectSomeValuesFrom(r D))
+SubClassOf(ObjectSomeValuesFrom(r D) E)
+"""
+
+ONTO_B = "SubClassOf(P Q)\nSubClassOf(Q S)\n"
+
+
+def _direct_subsumers(texts, cls):
+    from distel_tpu.core.incremental import IncrementalClassifier
+    from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    for t in texts:
+        inc.add_text(t)
+    return extract_taxonomy(inc.last_result).subsumers[cls]
+
+
+# ------------------------------------------ spill/restore under traffic
+
+
+def test_concurrent_clients_through_spill_restore_churn(tmp_path):
+    """A 1-byte budget makes EVERY cross-ontology touch evict the other
+    ontology: concurrent clients on two ontologies force constant
+    spill/restore interleaving.  Nothing may fail, and the final closure
+    must equal a direct classifier fed the same delta set (EL+ is
+    monotone — application order across threads cannot matter)."""
+    app = ServeApp(
+        memory_budget_bytes=1,
+        spill_dir=str(tmp_path),
+        fast_path_min_concepts=0,
+        workers=2,
+    )
+    server = make_server(app)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = ServeClient(
+        f"http://127.0.0.1:{server.server_address[1]}", timeout=300
+    )
+    try:
+        oid_a = client.load(BASE)["id"]
+        oid_b = client.load(ONTO_B)["id"]
+        failures = []
+        applied = {}  # thread name → delta texts it got acknowledged
+        stop = threading.Event()
+
+        def hammer(name, oid, base_cls, delta_parent):
+            mine = []
+            i = 0
+            while not stop.is_set():
+                try:
+                    if i % 3 == 2:
+                        client.subsumers(oid, base_cls)
+                    else:
+                        text = f"SubClassOf({name}x{i} {delta_parent})"
+                        client.delta(oid, text)
+                        mine.append(text)
+                    i += 1
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append((name, e))
+            applied[name] = mine
+
+        spec = [
+            ("ta0", oid_a, "A", "A"),
+            ("ta1", oid_a, "A", "B"),
+            ("tb0", oid_b, "P", "P"),
+        ]
+        threads = [
+            threading.Thread(target=hammer, args=s) for s in spec
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=300)
+        assert failures == []
+        # churn actually happened: at least one eviction+restore cycle
+        m = client.metrics_text()
+
+        def metric(name):
+            for line in m.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        assert metric("distel_registry_evictions_total") >= 1
+        assert metric("distel_registry_restores_total") >= 1
+        # the closure absorbed every acknowledged delta, in any order
+        texts_a = [BASE] + applied["ta0"] + applied["ta1"]
+        got = client.subsumers(oid_a, "A")["subsumers"]
+        assert got == _direct_subsumers(texts_a, "A")
+        if applied["ta0"]:
+            probe = applied["ta0"][0].split()[0].split("(")[1]
+            got = client.subsumers(oid_a, probe)["subsumers"]
+            assert got == _direct_subsumers(texts_a, probe)
+        texts_b = [ONTO_B] + applied["tb0"]
+        got = client.subsumers(oid_b, "P")["subsumers"]
+        assert got == _direct_subsumers(texts_b, "P")
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close(final_spill=False)
+
+
+# ---------------------------------------------- export vs live writers
+
+
+def test_registry_export_serializes_after_inflight_delta(tmp_path):
+    """A registry-level export (the migration spill) taken while a
+    writer holds the entry must wait the writer out: the handoff record
+    carries EXACTLY the acknowledged texts — never a torn state."""
+    from distel_tpu.config import ClassifierConfig
+
+    reg = OntologyRegistry(
+        ClassifierConfig(), spill_dir=str(tmp_path),
+        fast_path_min_concepts=0,
+    )
+    oid = reg.new_id()
+    reg.load(oid, BASE)
+    acked = []
+    errs = []
+    exported = {}
+    start = threading.Event()
+
+    def writer():
+        start.wait(5)
+        for i in range(6):
+            text = f"SubClassOf(W{i} A)"
+            try:
+                reg.delta(oid, [text])
+                acked.append(text)
+            except UnknownOntology:
+                return  # export won the race at an increment boundary
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+                return
+
+    def exporter():
+        start.wait(5)
+        time.sleep(0.05)  # land mid-writer
+        exported.update(reg.export(oid))
+
+    tw = threading.Thread(target=writer)
+    te = threading.Thread(target=exporter)
+    tw.start()
+    te.start()
+    start.set()
+    tw.join(timeout=300)
+    te.join(timeout=300)
+    assert errs == []
+    assert exported, "export never completed"
+    # exact consistency: the spilled texts are the base + every
+    # acknowledged delta (an unacked delta must not be in the record)
+    assert exported["texts"] == [BASE] + acked
+    # the handoff restores to a classifier that answers for all of them
+    rec = reg.adopt(
+        oid, exported["texts"], spill_path=exported["spill"], warm=True
+    )
+    assert rec["resident"] is True
+    from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+    tax = extract_taxonomy(reg.classifier(oid).last_result)
+    assert tax.subsumers["A"] == _direct_subsumers(
+        exported["texts"], "A"
+    )
+    # double adopt of a live id is refused loudly
+    with pytest.raises(ValueError):
+        reg.adopt(oid, exported["texts"], spill_path=exported["spill"])
+
+
+# ------------------------------------------- queue-full under a herd
+
+
+def test_scheduler_queue_full_under_concurrent_herd():
+    """16 concurrent submitters against workers=2, max_queue=4: every
+    request either completes or is refused with QueueFull at admission —
+    no hangs, no lost results, and the queue drains to zero."""
+    gate = threading.Event()
+    executed = []
+    exec_lock = threading.Lock()
+
+    def execute(key, kind, payloads):
+        gate.wait(timeout=60)
+        with exec_lock:
+            executed.extend(payloads)
+        return len(payloads)
+
+    sched = RequestScheduler(
+        execute, workers=2, max_queue=4, max_batch=1
+    )
+    admitted, rejected, done, hung = [], [], [], []
+    lock = threading.Lock()
+
+    def submitter(i):
+        try:
+            req = sched.submit(
+                f"k{i % 4}", "op", f"p{i}", deadline_s=60
+            )
+        except QueueFull:
+            with lock:
+                rejected.append(i)
+            return
+        with lock:
+            admitted.append(i)
+        try:
+            req.wait(60)
+            with lock:
+                done.append(i)
+        except Exception:  # noqa: BLE001
+            with lock:
+                hung.append(i)
+
+    threads = [
+        threading.Thread(target=submitter, args=(i,)) for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    # let the herd collide with the bounded queue before releasing
+    time.sleep(0.3)
+    gate.set()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "a submitter hung"
+    try:
+        assert len(admitted) + len(rejected) == 16
+        # the bounded queue really rejected under pressure: 2 workers
+        # can hold at most 2 executing + 4 queued when the herd lands
+        assert rejected, "herd never hit the bound"
+        assert sorted(done) == sorted(admitted)
+        assert hung == []
+        # every admitted payload executed exactly once
+        assert sorted(executed) == sorted(
+            f"p{i}" for i in admitted
+        )
+        deadline = time.monotonic() + 10
+        while sched.depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.depth() == 0
+    finally:
+        sched.close()
