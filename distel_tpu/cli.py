@@ -329,6 +329,94 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Step profiling: trace one full ``saturate()`` under the
+    ``jax.profiler`` and print the per-phase device-time split
+    (``runtime/profiling.profile_saturation`` — previously reachable
+    only through ``bench.py``).  ``--trace-dir`` keeps the raw xplane
+    capture for TensorBoard/XProf deep dives; without it the capture is
+    aggregated and discarded."""
+    from distel_tpu.config import enable_compile_cache
+    from distel_tpu.core.indexing import index_ontology
+    from distel_tpu.frontend.normalizer import normalize
+    from distel_tpu.owl import loader as parser_compat
+    from distel_tpu.runtime.classifier import make_engine
+    from distel_tpu.runtime.profiling import profile_saturation
+
+    cfg = _load_cfg(args)
+    enable_compile_cache(cfg.compile_cache_dir)
+    idx = index_ontology(normalize(parser_compat.load_file(args.ontology)))
+    engine = make_engine(cfg, idx)
+    if args.warm:
+        # one untraced run first: the profiled fixed point then
+        # measures execution, not its XLA compile
+        engine.saturate(cfg.max_iterations)
+    try:
+        prof = profile_saturation(
+            engine,
+            trace_dir=args.trace_dir,
+            max_iters=cfg.max_iterations,
+        )
+    except ImportError as e:
+        # profile_saturation fails BEFORE the traced run when the
+        # xplane aggregation stack is absent — say so plainly
+        print(
+            json.dumps(
+                {
+                    "error": f"profiling needs the xprof package: {e}",
+                    "hint": "pip install xprof (aggregates the "
+                            "jax.profiler xplane capture)",
+                }
+            ),
+            file=sys.stderr,
+        )
+        return 1
+    if args.trace_dir:
+        prof["trace_dir"] = args.trace_dir
+    print(json.dumps(prof, indent=2))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Fetch a recorded request trace from a serve/fleet process's
+    ``/debug/trace`` endpoint (the router stitches its spans with the
+    replicas' by trace_id).  ``--format chrome`` writes Chrome
+    trace-event JSON — load it in Perfetto (ui.perfetto.dev) or
+    chrome://tracing."""
+    from urllib.parse import quote
+    from urllib.request import urlopen
+
+    base = args.url.rstrip("/")
+    qs = []
+    if args.trace_id:
+        qs.append(f"trace_id={quote(args.trace_id)}")
+    if args.format == "chrome":
+        qs.append("format=chrome")
+    if args.limit is not None:
+        qs.append(f"limit={args.limit}")
+    if args.no_stitch:
+        qs.append("stitch=0")
+    url = base + "/debug/trace" + ("?" + "&".join(qs) if qs else "")
+    with urlopen(url, timeout=args.timeout) as resp:
+        payload = resp.read()
+    if args.output:
+        with open(args.output, "wb") as f:
+            f.write(payload)
+        doc = json.loads(payload)
+        n = len(
+            doc.get("traceEvents", doc.get("spans", []))
+        )
+        print(
+            json.dumps(
+                {"written": args.output, "format": args.format,
+                 "records": n}
+            )
+        )
+    else:
+        sys.stdout.write(payload.decode("utf-8"))
+    return 0
+
+
 def cmd_warmup(args) -> int:
     """Warmup precompile: resolve each sample corpus to its shape
     bucket and AOT-build that bucket's programs into the in-process
@@ -468,6 +556,7 @@ def cmd_fleet(args) -> int:
             heartbeat_interval_s=cfg.fleet_heartbeat_interval_s,
             eject_failures=cfg.fleet_eject_failures,
             rebalance_interval_s=cfg.fleet_rebalance_interval_s,
+            config=cfg,
         )
         router.start()
         server = make_server(router, args.host, args.port)
@@ -509,7 +598,27 @@ def cmd_fleet(args) -> int:
         server.server_close()
         router.close()
         sup.stop(graceful=True)
-    print(json.dumps({"shutdown": "graceful", "replicas": n}), flush=True)
+    # the flight recorder is the fleet's black box: dump it next to the
+    # spills on the way out and surface the tail in the shutdown record
+    import os as _os
+
+    flight_path = _os.path.join(args.spill_dir, "flight_router.jsonl")
+    try:
+        dumped = router.flight.dump(flight_path)
+    except OSError:
+        flight_path, dumped = None, 0
+    print(
+        json.dumps(
+            {
+                "shutdown": "graceful",
+                "replicas": n,
+                "flight_events": dumped,
+                "flight_dump": flight_path,
+                "recent_events": router.flight.events(limit=5),
+            }
+        ),
+        flush=True,
+    )
     return 0
 
 
@@ -674,6 +783,45 @@ def main(argv=None) -> int:
     w.add_argument("--serial", action="store_true",
                    help="compile buckets one at a time (debugging)")
     w.set_defaults(fn=cmd_warmup)
+
+    pr = sub.add_parser(
+        "profile",
+        help="per-phase device-time split of one saturate() "
+             "(jax.profiler capture, aggregated by named scope)",
+    )
+    pr.add_argument("ontology")
+    pr.add_argument("--config", help="properties/config file")
+    pr.add_argument("--trace-dir", default=None,
+                    help="keep the raw xplane capture here (for "
+                         "TensorBoard/XProf); default: aggregate and "
+                         "discard a temp capture")
+    pr.add_argument("--warm", action="store_true",
+                    help="run one untraced fixed point first so the "
+                         "profiled run measures execution, not compile")
+    pr.set_defaults(fn=cmd_profile)
+
+    tr = sub.add_parser(
+        "trace",
+        help="fetch a request trace from a serve/fleet /debug/trace "
+             "endpoint (router stitches replicas by trace_id)",
+    )
+    tr.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (32 hex chars — ServeClient keeps "
+                         "the last one on .last_trace_id); omitted: "
+                         "every buffered span")
+    tr.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="router or replica base url")
+    tr.add_argument("--format", choices=("json", "chrome"),
+                    default="json",
+                    help="chrome: Perfetto-loadable trace-event JSON")
+    tr.add_argument("--output", "-o", default=None,
+                    help="write the payload here instead of stdout")
+    tr.add_argument("--limit", type=int, default=None,
+                    help="newest N spans only")
+    tr.add_argument("--no-stitch", action="store_true",
+                    help="router only: skip fetching replica spans")
+    tr.add_argument("--timeout", type=float, default=30.0)
+    tr.set_defaults(fn=cmd_trace)
 
     b = sub.add_parser("bench", help="timing loop on one ontology")
     b.add_argument("ontology")

@@ -155,6 +155,32 @@ class ClassifierConfig:
     fleet_eject_failures: int = 3
     #: rebalance sweep period (each sweep migrates at most one ontology)
     fleet_rebalance_interval_s: float = 2.0
+    #: observability (``distel_tpu/obs/``): end-to-end request tracing
+    #: + the fleet flight recorder.  ``obs_enable=False`` takes every
+    #: span fully off-path (no ring writes, no thread-local, no
+    #: traceparent parsing) — the flight recorder stays on (it is the
+    #: post-incident record; its cost is one dict per control-plane
+    #: event)
+    obs_enable: bool = True
+    #: fraction of root requests that record spans (children inherit
+    #: the parent's decision via the traceparent sampled flag)
+    obs_sample_rate: float = 1.0
+    #: record per-saturation-round span events on traced REBUILD
+    #: classifies by running the observed fixed-point loop (byte-
+    #: identical per retired round, ~parity wall under the default
+    #: pipeline).  Off by default: the observed program is jitted per
+    #: engine OUTSIDE the bucket program registry, so it would charge a
+    #: fresh XLA compile to every traced load — a warmed bucket's
+    #: compile-free load guarantee wins unless the operator opts into
+    #: round-level visibility.  (Runs that are already observed —
+    #: scale probes, anything through ``saturate_observed`` — emit
+    #: round events on traced requests regardless of this knob.)
+    obs_trace_rounds: bool = False
+    #: finished-span ring capacity per process (bounded memory — a
+    #: resident server traces forever without growing)
+    obs_ring_capacity: int = 2048
+    #: flight-recorder event ring capacity per process
+    obs_flight_capacity: int = 4096
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -235,6 +261,18 @@ class ClassifierConfig:
             cfg.fleet_rebalance_interval_s = float(
                 raw["fleet.rebalance.interval_s"]
             )
+        if "obs.enable" in raw:
+            cfg.obs_enable = raw["obs.enable"].lower() == "true"
+        if "obs.sample_rate" in raw:
+            cfg.obs_sample_rate = float(raw["obs.sample_rate"])
+        if "obs.trace_rounds" in raw:
+            cfg.obs_trace_rounds = (
+                raw["obs.trace_rounds"].lower() == "true"
+            )
+        if "obs.ring.capacity" in raw:
+            cfg.obs_ring_capacity = int(raw["obs.ring.capacity"])
+        if "obs.flight.capacity" in raw:
+            cfg.obs_flight_capacity = int(raw["obs.flight.capacity"])
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v
@@ -259,6 +297,16 @@ class ClassifierConfig:
         return {
             "enable": self.pipeline,
             "depth": self.pipeline_depth,
+        }
+
+    def tracer_kwargs(self) -> dict:
+        """The :class:`~distel_tpu.obs.SpanRecorder` construction kwargs
+        for this config — the serve/router apps build their recorders
+        from it."""
+        return {
+            "enable": self.obs_enable,
+            "sample_rate": self.obs_sample_rate,
+            "capacity": self.obs_ring_capacity,
         }
 
     def matmul_jnp_dtype(self):

@@ -348,10 +348,32 @@ class IncrementalClassifier:
         # state to peak HBM — the difference between the incremental and
         # batch ceilings
         self.last_result = None
-        result = engine.saturate(
-            self.config.max_iterations,
-            initial=self._pop_state(),
-        )
+        from distel_tpu.obs import trace as obs_trace
+
+        _sp = obs_trace.active_span()
+        if (
+            self.config.obs_trace_rounds
+            and _sp is not None
+            and _sp.sampled  # an unsampled carrier records nothing —
+            # it must not pay the observed loop either
+            and hasattr(engine, "saturate_observed")
+        ):
+            # traced request under obs.trace_rounds: run the observed
+            # loop (byte-identical per retired round, ~parity wall
+            # under the default pipeline — tests/test_pipeline.py pins
+            # both) so every saturation round lands as a span event on
+            # the request's trace.  Opt-in because the observed
+            # program compiles OUTSIDE the bucket registry — see the
+            # config knob's comment.
+            result = engine.saturate_observed(
+                self.config.max_iterations,
+                initial=self._pop_state(),
+            )
+        else:
+            result = engine.saturate(
+                self.config.max_iterations,
+                initial=self._pop_state(),
+            )
         self.last_compile = getattr(engine, "compile_stats", None)
         if isinstance(engine, RowPackedSaturationEngine):
             self._base_engine, self._base_idx = engine, idx

@@ -1,0 +1,45 @@
+"""Observability: end-to-end request tracing + the fleet flight
+recorder (stdlib only — importable everywhere, off-path when disabled).
+
+Layout::
+
+    trace.py   TraceContext (W3C ``traceparent`` wire form), Span,
+               SpanRecorder (bounded ring, config-gated sampling,
+               JSONL + Chrome trace-event export — Perfetto-loadable),
+               thread-local propagation helpers the serve plane,
+               scheduler, registry, and engine round hooks share
+    flight.py  FlightRecorder — bounded structured control-plane event
+               log (heartbeat verdicts, ejections, migration stages,
+               journal replays), queryable at ``/debug/events`` and
+               dumped as JSONL on shutdown
+
+Config knobs (``config.ClassifierConfig`` / ``obs.*`` properties):
+``obs.enable``, ``obs.sample_rate``, ``obs.ring.capacity``,
+``obs.flight.capacity``.
+"""
+
+from distel_tpu.obs.flight import FlightRecorder
+from distel_tpu.obs.trace import (
+    NOOP,
+    Span,
+    SpanRecorder,
+    TraceContext,
+    active_span,
+    add_span_event,
+    child_span,
+    chrome_trace,
+    current_context,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "NOOP",
+    "Span",
+    "SpanRecorder",
+    "TraceContext",
+    "active_span",
+    "add_span_event",
+    "child_span",
+    "chrome_trace",
+    "current_context",
+]

@@ -1,0 +1,92 @@
+"""Fleet flight recorder: a bounded, structured control-plane event log.
+
+Every hard incident on record (the r4/r5 tunnel black-holes, the
+SCALE_r05 128k run killed blind, PR 6's intermittent split-brain) was
+diagnosed from ad-hoc prints because nothing kept a causal, timestamped
+record of what the control plane *decided*.  This is that record: the
+router (and each replica) appends one dict per state-changing event —
+heartbeat verdicts, ejections, respawns, journal replays, migration
+stages with per-stage timing, rebalance proposals, registry
+spill/restore/evict — into a ``deque(maxlen=capacity)``, queryable at
+``/debug/events``, dumped as JSONL on shutdown, and surfaced by
+``cli fleet``.
+
+Events carry a monotonic per-recorder ``seq`` (ordering survives equal
+wall-clock stamps) and, when a trace span is active on the recording
+thread, the span's ``trace_id`` — so a migration triggered by an admin
+request correlates with that request's trace.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from distel_tpu.obs import trace as _trace
+
+
+class FlightRecorder:
+    """Thread-safe bounded event log.  ``record`` is cheap (dict build +
+    deque append under one lock) — safe on heartbeat/migration paths."""
+
+    def __init__(self, capacity: int = 4096, service: str = "distel"):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.service = service
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one event; returns the recorded dict (tests assert on
+        it).  ``kind`` is the event type (``eject``, ``migrate_start``,
+        ...); ``fields`` are its structured payload."""
+        ev = {"kind": kind, "ts": time.time(), "service": self.service}
+        sp = _trace.active_span()
+        if sp is not None:
+            ev["trace_id"] = sp.trace_id
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._ring.append(ev)
+        return ev
+
+    def events(
+        self,
+        kind: Optional[str] = None,
+        limit: Optional[int] = None,
+        **match,
+    ) -> List[dict]:
+        """Events oldest-first, filtered by ``kind`` and/or exact field
+        matches (``oid="ont-0001"``), bounded to the newest ``limit``."""
+        with self._lock:
+            out = list(self._ring)
+        if kind is not None:
+            out = [e for e in out if e["kind"] == kind]
+        for key, want in match.items():
+            out = [e for e in out if e.get(key) == want]
+        if limit is not None and limit >= 0:
+            # guard limit=0 explicitly: out[-0:] is the WHOLE list
+            out = out[-limit:] if limit else []
+        return out
+
+    def jsonl(self) -> str:
+        lines = [json.dumps(e) for e in self.events()]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump(self, path: str) -> int:
+        """Write every buffered event as JSONL; returns the count.  The
+        shutdown hook — a SIGTERM'd fleet leaves its black box on disk."""
+        events = self.events()
+        with open(path, "w", encoding="utf-8") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+        return len(events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()

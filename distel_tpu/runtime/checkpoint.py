@@ -22,24 +22,31 @@ from distel_tpu.core.indexing import IndexedOntology
 
 
 def save_snapshot(
-    path: str, result: SaturationResult, compressed: bool = True
+    path: str,
+    result: SaturationResult,
+    compressed: bool = True,
+    extra_meta: Optional[dict] = None,
 ) -> None:
     """``compressed=False`` trades ~8x disk for minutes of single-core
     zlib time — the right call for multi-GB MID-RUN snapshots on the
     virtual-mesh scale probes, where the snapshot interval competes with
-    the superstep walls for the same core (r4 verdict task 1)."""
+    the superstep walls for the same core (r4 verdict task 1).
+
+    ``extra_meta``: JSON-serializable fields merged into the snapshot's
+    ``meta`` record — scale_probe stamps its ``run_id`` here so resumed
+    runs correlate across sessions in the trace tooling."""
     _savez = np.savez_compressed if compressed else np.savez
     idx = result.idx
+    meta = {"time": time.time(), "converged": result.converged}
+    if extra_meta:
+        meta.update(extra_meta)
     common = dict(
         iterations=np.int64(result.iterations),
         derivations=np.int64(result.derivations),
         concept_names=np.array(idx.concept_names, dtype=object),
         role_names=np.array(idx.role_names, dtype=object),
         links=idx.links,
-        meta=np.array(
-            [json.dumps({"time": time.time(), "converged": result.converged})],
-            dtype=object,
-        ),
+        meta=np.array([json.dumps(meta)], dtype=object),
     )
     if result.transposed:
         # v2: the row-packed engine's wire form verbatim (subsumer-major

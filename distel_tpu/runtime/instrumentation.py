@@ -17,6 +17,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from distel_tpu.obs import trace as _obs_trace
+
 
 @dataclass
 class PhaseTimer:
@@ -26,6 +28,11 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        # when the calling thread carries a trace span (a traced serve
+        # request), each phase also lands as a child span — one
+        # thread-local read when untraced, nothing more
+        obs_sp = _obs_trace.active_span()
+        wall0 = time.time() if obs_sp is not None else 0.0
         t0 = time.perf_counter()
         try:
             yield
@@ -34,6 +41,8 @@ class PhaseTimer:
             self.phases[name] = self.phases.get(name, 0.0) + dt
             if name not in self.order:
                 self.order.append(name)
+            if obs_sp is not None:
+                _obs_trace.add_phase_span(obs_sp, name, wall0, dt)
             if self.enabled:
                 print(f"[distel] phase {name}: {dt * 1000:.1f} ms", flush=True)
 
@@ -217,6 +226,11 @@ class FrontierAggregate:
         self.retire_seconds = 0.0
 
     def record(self, st: "FrontierStats") -> None:
+        # a traced request's rounds also land as span events on the
+        # recording thread's active span (the adaptive/observed
+        # controllers record from the thread that ran the classify, so
+        # the scheduler's lane-exec span is active here)
+        _obs_trace.add_round_event(st)
         with self._lock:
             if st.tier == "sparse":
                 self.sparse_rounds += 1

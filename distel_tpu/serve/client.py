@@ -35,6 +35,8 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
+from distel_tpu.obs import trace as obs_trace
+
 
 class ServeError(Exception):
     def __init__(self, status: int, body, headers=None):
@@ -61,16 +63,27 @@ class ServeClient:
         retries: int = 0,
         backoff_s: float = 0.25,
         max_backoff_s: float = 10.0,
+        tracer=None,
     ):
         """``retries=0`` (default) preserves the raise-on-429/503
         behavior; ``retries=N`` re-sends up to N times with jittered
         exponential backoff (base ``backoff_s``, capped at
-        ``max_backoff_s``), preferring the server's ``Retry-After``."""
+        ``max_backoff_s``), preferring the server's ``Retry-After``.
+
+        ``tracer``: an optional :class:`~distel_tpu.obs.SpanRecorder` —
+        every request then runs inside a client span whose W3C
+        ``traceparent`` rides the request headers, so the router and
+        replica spans stitch to it by trace_id; the last request's
+        trace id is kept on :attr:`last_trace_id` (feed it to
+        ``cli trace`` or ``/debug/trace?trace_id=``)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.retries = retries
         self.backoff_s = backoff_s
         self.max_backoff_s = max_backoff_s
+        self.tracer = tracer
+        #: trace id of the most recent traced request (None untraced)
+        self.last_trace_id: Optional[str] = None
 
     # ------------------------------------------------------------- http
 
@@ -94,6 +107,31 @@ class ServeClient:
         doc: Optional[dict] = None,
         deadline_s: Optional[float] = None,
         retry_statuses=RETRYABLE_STATUSES,
+    ):
+        if self.tracer is None or not self.tracer.enabled:
+            return self._request_loop(
+                method, path, doc, deadline_s, retry_statuses
+            )
+        # one client span covers the whole logical request (every retry
+        # re-sends the same traceparent, so server-side spans of all
+        # attempts stitch to it)
+        with self.tracer.span(
+            f"client {method} {path.split('?', 1)[0]}",
+            attrs={"method": method, "path": path},
+        ) as span:
+            if span.sampled:
+                self.last_trace_id = span.trace_id
+            return self._request_loop(
+                method, path, doc, deadline_s, retry_statuses
+            )
+
+    def _request_loop(
+        self,
+        method: str,
+        path: str,
+        doc: Optional[dict],
+        deadline_s: Optional[float],
+        retry_statuses,
     ):
         attempt = 0
         while True:
@@ -130,6 +168,13 @@ class ServeClient:
             req.add_header("Content-Type", "application/json")
         if deadline_s is not None:
             req.add_header("X-Distel-Deadline-S", str(deadline_s))
+        # propagate the calling thread's trace context (the client
+        # span opened by _request, or any surrounding server span)
+        ctx = obs_trace.current_context()
+        if ctx is not None:
+            req.add_header(
+                obs_trace.TRACEPARENT_HEADER, ctx.to_traceparent()
+            )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 raw = resp.read()

@@ -49,6 +49,10 @@ class ReplicaApp(ServeApp):
     def __init__(self, *args, replica_id: str = "r0", **kw):
         super().__init__(*args, **kw)
         self.replica_id = replica_id
+        # trace spans and flight events carry the replica identity —
+        # the router's stitched /debug/trace labels each process track
+        self.tracer.service = f"replica:{replica_id}"
+        self.flight.service = f"replica:{replica_id}"
         self.metrics.describe(
             "distel_registry_exports_total",
             "ontologies migrated out (spill + deregister)",

@@ -44,6 +44,9 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
+from distel_tpu.obs import trace as obs_trace
+from distel_tpu.obs.flight import FlightRecorder
+from distel_tpu.obs.trace import SpanRecorder
 from distel_tpu.serve.fleet.placement import (
     NoHealthyReplica,
     PlacementTable,
@@ -54,6 +57,8 @@ from distel_tpu.serve.server import (
     HTTPError,
     _dumps,
     _json_doc,
+    debug_events_response,
+    debug_trace_response,
     endpoint_label,
     match_route,
 )
@@ -72,6 +77,10 @@ _ROUTES = (
     ("POST", re.compile(r"^/fleet/migrate/?$"), "migrate",
      "/fleet/migrate"),
     ("GET", re.compile(r"^/fleet/status/?$"), "status", "/fleet/status"),
+    ("GET", re.compile(r"^/debug/trace/?$"), "debug_trace",
+     "/debug/trace"),
+    ("GET", re.compile(r"^/debug/events/?$"), "debug_events",
+     "/debug/events"),
 )
 
 
@@ -94,12 +103,29 @@ class RouterApp:
         rebalance_interval_s: float = 2.0,
         migration_hold_timeout_s: float = 120.0,
         proxy_timeout_s: float = 600.0,
+        config=None,
     ):
         """``replicas``: ``[(rid, base_url), ...]`` — a static fleet
         (tests, external process manager); with a ``supervisor``
         (:class:`~distel_tpu.serve.fleet.supervisor.ReplicaSupervisor`)
-        ejected replicas are respawned and re-registered."""
+        ejected replicas are respawned and re-registered.
+
+        ``config``: an optional ``ClassifierConfig`` — only its
+        ``obs_*`` knobs are read here (trace sampling/ring sizes; the
+        replica-side knobs ride the replica processes' own configs)."""
+        from distel_tpu.config import ClassifierConfig
+
+        cfg = config or ClassifierConfig()
         self.supervisor = supervisor
+        #: request tracing (spans served by /debug/trace, stitched with
+        #: the replicas' by trace_id) + the fleet flight recorder (the
+        #: causal control-plane record served by /debug/events)
+        self.tracer = SpanRecorder(
+            service="router", **cfg.tracer_kwargs()
+        )
+        self.flight = FlightRecorder(
+            capacity=cfg.obs_flight_capacity, service="router"
+        )
         self.table = PlacementTable(depth_divergence=depth_divergence)
         for rid, url in replicas:
             self.table.add_replica(rid, url)
@@ -241,27 +267,43 @@ class RouterApp:
             if deadline_s is not None
             else self.proxy_timeout_s
         )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return (
-                    resp.status,
-                    resp.headers.get("Content-Type", "application/json"),
-                    resp.read(),
+        with obs_trace.child_span(
+            f"forward {replica.rid}",
+            {"replica": replica.rid, "method": method, "path": path},
+        ):
+            # propagate the trace context FROM INSIDE the forward span
+            # (now the active one) so the replica's server span parents
+            # on this hop, not on the router's http span
+            ctx = obs_trace.current_context()
+            if ctx is not None:
+                req.add_header(
+                    obs_trace.TRACEPARENT_HEADER, ctx.to_traceparent()
                 )
-        except urllib.error.HTTPError as e:
-            payload = e.read()
-            raise HTTPError(
-                e.code,
-                _error_message(payload),
-                {k: v for k, v in e.headers.items()
-                 if k.lower() == "retry-after"},
-            )
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            replica.note_failure()
-            self.metrics.counter_inc("distel_router_proxy_errors_total")
-            raise HTTPError(
-                502, f"replica {replica.rid} unreachable: {e}"
-            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return (
+                        resp.status,
+                        resp.headers.get(
+                            "Content-Type", "application/json"
+                        ),
+                        resp.read(),
+                    )
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                raise HTTPError(
+                    e.code,
+                    _error_message(payload),
+                    {k: v for k, v in e.headers.items()
+                     if k.lower() == "retry-after"},
+                )
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                replica.note_failure()
+                self.metrics.counter_inc(
+                    "distel_router_proxy_errors_total"
+                )
+                raise HTTPError(
+                    502, f"replica {replica.rid} unreachable: {e}"
+                )
 
     # ------------------------------------------------------- HTTP plane
 
@@ -341,37 +383,84 @@ class RouterApp:
         }
         return 200, "application/json", _dumps(doc)
 
-    def _ep_metrics(self, *, query, body, deadline_s, path):
-        # scrape replicas CONCURRENTLY with a short per-replica budget:
-        # a replica grinding an inline device program answers late, and
-        # serial 10 s waits would push the whole fleet scrape past a
-        # standard Prometheus scrape_timeout exactly when visibility
-        # matters most
+    def _fanout_get(self, path: str, parse):
+        """Concurrent GET of ``path`` against every healthy replica
+        with a short per-replica budget — a replica grinding an inline
+        device program answers late, and serial waits would wedge the
+        metrics/debug planes exactly when visibility matters most.
+        ``parse(bytes)`` maps each body; a slow/dead/garbled replica is
+        skipped, never fatal.  Returns ``[(rid, parsed), ...]``."""
         from concurrent.futures import ThreadPoolExecutor
 
-        def scrape(st):
+        def fetch(st):
             try:
-                req = urllib.request.Request(st.url + "/metrics")
+                req = urllib.request.Request(st.url + path)
                 with urllib.request.urlopen(req, timeout=3) as resp:
-                    return st.rid, resp.read().decode("utf-8")
-            except (urllib.error.URLError, OSError, TimeoutError):
-                return st.rid, None  # slow/dead: skip, don't kill scrape
+                    return st.rid, parse(resp.read())
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    ValueError):
+                return st.rid, None
 
         live = self.table.healthy_replicas()
-        pages = {}
-        if live:
-            with ThreadPoolExecutor(max_workers=len(live)) as pool:
-                for rid, page in pool.map(scrape, live):
-                    if page is not None:
-                        pages[rid] = page
+        if not live:
+            return []
+        with ThreadPoolExecutor(max_workers=len(live)) as pool:
+            return [
+                (rid, parsed)
+                for rid, parsed in pool.map(fetch, live)
+                if parsed is not None
+            ]
+
+    def _ep_metrics(self, *, query, body, deadline_s, path):
+        pages = dict(
+            self._fanout_get("/metrics", lambda b: b.decode("utf-8"))
+        )
         text = self.metrics.render() + aggregate_expositions(pages)
         return 200, "text/plain; version=0.0.4", text.encode("utf-8")
 
     def _ep_status(self, *, query, body, deadline_s, path):
         with self._journal_lock:
             journal = {o: len(t) for o, t in self._journal.items()}
-        doc = {**self.table.stats(), "journal_texts": journal}
+        doc = {
+            **self.table.stats(),
+            "journal_texts": journal,
+            # the flight recorder's tail, inline — `cli fleet` and a
+            # quick curl see the latest control-plane decisions without
+            # a second round trip
+            "recent_events": self.flight.events(limit=10),
+        }
         return 200, "application/json", _dumps(doc)
+
+    def _ep_debug_events(self, *, query, body, deadline_s, path):
+        """Fleet flight-recorder events (``?kind=``, ``?rid=``,
+        ``?oid=``, ``?limit=`` filters)."""
+        return debug_events_response(
+            self.flight, query, match_keys=("oid", "rid")
+        )
+
+    def _ep_debug_trace(self, *, query, body, deadline_s, path):
+        """Recorded router spans; with ``?trace_id=`` the router also
+        fetches that trace's spans from every healthy replica and
+        STITCHES them into one view (they share the trace_id the
+        traceparent header carried) — ``?stitch=0`` disables the
+        fan-out, ``?format=chrome`` returns Perfetto-loadable Chrome
+        trace-event JSON."""
+        return debug_trace_response(
+            self.tracer, query, stitch=self._replica_spans
+        )
+
+    def _replica_spans(self, trace_id: str) -> list:
+        """Fetch one trace's spans from every healthy replica (same
+        concurrent fan-out as the /metrics scrape)."""
+        from urllib.parse import quote
+
+        out = []
+        for _rid, spans in self._fanout_get(
+            "/debug/trace?trace_id=" + quote(trace_id),
+            lambda b: json.loads(b).get("spans", []),
+        ):
+            out.extend(spans)
+        return out
 
     def _ep_migrate(self, *, query, body, deadline_s, path):
         doc = _json_doc(body)
@@ -398,31 +487,51 @@ class RouterApp:
             if src is None:
                 raise HTTPError(404, f"unknown ontology {oid!r}")
             self._migrating.add(oid)
+        self.flight.record("migrate_start", oid=oid, src=src.rid)
         try:
             # drain: every forwarded request for oid has returned
             deadline = time.monotonic() + self.migration_hold_timeout_s
             with self._cv:
                 while self._inflight.get(oid, 0) > 0:
                     if time.monotonic() > deadline:
+                        self.flight.record(
+                            "migrate_failed", oid=oid, src=src.rid,
+                            stage="drain",
+                            error="in-flight requests never drained",
+                        )
                         raise HTTPError(
                             503, f"in-flight requests for {oid!r} "
                             "never drained"
                         )
                     self._cv.wait(timeout=1.0)
+            self.flight.record(
+                "migrate_drain", oid=oid, src=src.rid,
+                wall_s=round(time.monotonic() - t0, 4),
+            )
             dst = self._pick_destination(src, dst_rid)
             # source: spill + deregister (rides the oid's scheduler
             # lane, so it serializes after everything already admitted)
+            t_export = time.monotonic()
             try:
                 _, _, out = self._forward(
                     src, "POST", "/fleet/migrate",
                     json.dumps({"id": oid}).encode("utf-8"), None,
                 )
-            except HTTPError:
+            except HTTPError as e:
                 # a source that died under us: fall back to journal
                 # replay onto a healthy replica (we hold the oid)
+                self.flight.record(
+                    "migrate_export_failed", oid=oid, src=src.rid,
+                    error=str(e)[:200],
+                )
                 if not src.healthy and self._replay_onto_healthy(oid):
                     self.metrics.counter_inc(
                         "distel_fleet_recoveries_total"
+                    )
+                    self.flight.record(
+                        "migrate_recovered", oid=oid, src=src.rid,
+                        to=self.table.lookup(oid).rid,
+                        wall_s=round(time.monotonic() - t0, 4),
                     )
                     return {
                         "id": oid,
@@ -432,6 +541,10 @@ class RouterApp:
                         "wall_s": round(time.monotonic() - t0, 4),
                     }
                 raise
+            self.flight.record(
+                "migrate_export", oid=oid, src=src.rid,
+                wall_s=round(time.monotonic() - t_export, 4),
+            )
             handoff = json.loads(out)
             adopt = json.dumps(
                 {
@@ -441,24 +554,40 @@ class RouterApp:
                     "warm": True,
                 }
             ).encode("utf-8")
+            t_adopt = time.monotonic()
             try:
                 self._forward(dst, "POST", "/fleet/adopt", adopt, None)
+                self.flight.record(
+                    "migrate_adopt", oid=oid, dst=dst.rid,
+                    wall_s=round(time.monotonic() - t_adopt, 4),
+                )
             except HTTPError as e:
                 if e.status == 409:
                     # the destination already holds this id (a raced
                     # recovery replay landed first): its copy answers
                     # for the same acked corpus — commit to it and let
                     # the exported spill age out
-                    pass
+                    self.flight.record(
+                        "migrate_adopt", oid=oid, dst=dst.rid,
+                        committed_409=True,
+                        wall_s=round(time.monotonic() - t_adopt, 4),
+                    )
                 else:
                     # roll back: the spill restores at the source just
                     # as well — placement only commits on success
                     self.metrics.counter_inc(
                         "distel_fleet_migration_failures_total"
                     )
+                    self.flight.record(
+                        "migrate_adopt_failed", oid=oid, dst=dst.rid,
+                        error=str(e)[:200],
+                    )
                     try:
                         self._forward(
                             src, "POST", "/fleet/adopt", adopt, None
+                        )
+                        self.flight.record(
+                            "migrate_rollback", oid=oid, src=src.rid
                         )
                     except HTTPError as rb:
                         # rollback refused too (src overloaded or gone):
@@ -471,6 +600,14 @@ class RouterApp:
                         elif self._replay_onto_healthy(oid):
                             self.metrics.counter_inc(
                                 "distel_fleet_recoveries_total"
+                            )
+                            self.flight.record(
+                                "migrate_recovered", oid=oid,
+                                src=src.rid,
+                                to=self.table.lookup(oid).rid,
+                                wall_s=round(
+                                    time.monotonic() - t0, 4
+                                ),
                             )
                             return {
                                 "id": oid,
@@ -488,6 +625,10 @@ class RouterApp:
             self.metrics.counter_inc("distel_fleet_migrations_total")
             wall_s = time.monotonic() - t0
             self.metrics.observe("distel_fleet_migration_seconds", wall_s)
+            self.flight.record(
+                "migrate_commit", oid=oid, src=src.rid, dst=dst.rid,
+                wall_s=round(wall_s, 4),
+            )
             return {
                 "id": oid,
                 "from": src.rid,
@@ -536,6 +677,8 @@ class RouterApp:
         for st in self.table.replicas():
             if not st.healthy:
                 continue
+            was_f = st.consecutive_failures
+            was_t = st.consecutive_timeouts
             try:
                 req = urllib.request.Request(st.url + "/healthz")
                 with urllib.request.urlopen(
@@ -550,6 +693,24 @@ class RouterApp:
                 st.note_failure(timeout=soft)
             except OSError:
                 st.note_failure()
+            # flight-record the probe VERDICT transitions (not every ok
+            # sweep): each miss with its busy-vs-dead reading, and the
+            # recovery that reset a failure streak
+            if st.consecutive_failures > was_f:
+                self.flight.record(
+                    "heartbeat_miss", rid=st.rid, verdict="dead",
+                    consecutive=st.consecutive_failures,
+                )
+            elif st.consecutive_timeouts > was_t:
+                self.flight.record(
+                    "heartbeat_miss", rid=st.rid, verdict="busy",
+                    consecutive=st.consecutive_timeouts,
+                )
+            elif was_f or was_t:
+                self.flight.record(
+                    "heartbeat_recovered", rid=st.rid,
+                    after_failures=was_f, after_timeouts=was_t,
+                )
             dead_process = (
                 self.supervisor is not None
                 and not self.supervisor.alive(st.rid)
@@ -569,14 +730,33 @@ class RouterApp:
         detecting OTHER replicas' failures meanwhile."""
         stranded = self.table.mark_ejected(st.rid)
         self.metrics.counter_inc("distel_fleet_ejections_total")
+        self.flight.record(
+            "eject", rid=st.rid, stranded=list(stranded),
+            consecutive_failures=st.consecutive_failures,
+            consecutive_timeouts=st.consecutive_timeouts,
+            dead_process=(
+                self.supervisor is not None
+                and not self.supervisor.alive(st.rid)
+            ),
+        )
 
         def _respawn_and_recover():
             if self.supervisor is not None:
+                t0 = time.monotonic()
                 try:
                     url = self.supervisor.respawn(st.rid)
                     self.table.mark_respawned(st.rid, url)
-                except Exception:
-                    pass  # stays ejected; recovery still re-places
+                    self.flight.record(
+                        "respawn", rid=st.rid, url=url, ok=True,
+                        wall_s=round(time.monotonic() - t0, 4),
+                    )
+                except Exception as e:
+                    # stays ejected; recovery still re-places
+                    self.flight.record(
+                        "respawn", rid=st.rid, ok=False,
+                        error=f"{type(e).__name__}: {e}"[:200],
+                        wall_s=round(time.monotonic() - t0, 4),
+                    )
             self._recover(stranded)
 
         t = threading.Thread(
@@ -609,6 +789,13 @@ class RouterApp:
                     self.metrics.counter_inc(
                         "distel_fleet_recoveries_total"
                     )
+                    self.flight.record(
+                        "recover", oid=oid,
+                        to=self.table.lookup(oid).rid,
+                        texts=len(self._journal_texts(oid)),
+                    )
+                else:
+                    self.flight.record("recover_failed", oid=oid)
             finally:
                 with self._cv:
                     self._migrating.discard(oid)
@@ -622,22 +809,39 @@ class RouterApp:
         texts = self._journal_texts(oid)
         if not texts:
             self.table.drop(oid)
+            self.flight.record(
+                "journal_replay", oid=oid, ok=False, reason="no journal"
+            )
             return False
         try:
             dst = self.table.place(oid)
         except NoHealthyReplica:
             self.table.drop(oid)
+            self.flight.record(
+                "journal_replay", oid=oid, ok=False,
+                reason="no healthy replica",
+            )
             return False
         adopt = json.dumps(
             {"id": oid, "texts": texts, "warm": True}
         ).encode("utf-8")
+        t0 = time.monotonic()
         try:
             self._forward(dst, "POST", "/fleet/adopt", adopt, None)
         except HTTPError as e:
             if e.status != 409:  # 409: dst already holds it — commit
                 self.table.drop(oid)
+                self.flight.record(
+                    "journal_replay", oid=oid, dst=dst.rid, ok=False,
+                    reason=str(e)[:200],
+                )
                 return False
         self.table.assign(oid, dst.rid)
+        self.flight.record(
+            "journal_replay", oid=oid, dst=dst.rid, ok=True,
+            texts=len(texts),
+            wall_s=round(time.monotonic() - t0, 4),
+        )
         return True
 
     def _heartbeat_loop(self) -> None:
@@ -656,7 +860,10 @@ class RouterApp:
         proposal = self.table.propose_migration()
         if proposal is None:
             return None
-        oid, _src, dst = proposal
+        oid, src, dst = proposal
+        self.flight.record(
+            "rebalance_proposal", oid=oid, src=src, dst=dst
+        )
         try:
             return self.migrate(oid, dst_rid=dst)
         except HTTPError:

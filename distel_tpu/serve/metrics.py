@@ -25,26 +25,84 @@ DEFAULT_BUCKETS = (
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
-#: ``name{labels} value [timestamp]`` — the shape of one exposition
-#: sample line (labels optional)
-_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(.+)$")
+#: ``name`` prefix of one exposition sample line (the label block, when
+#: present, is scanned by :func:`split_sample` — a regex over the whole
+#: line would mis-split label VALUES containing ``}`` or spaces)
+_NAME_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)")
 
 #: suffixes histogram/summary samples hang off their family name
 _FAMILY_SUFFIXES = ("_bucket", "_sum", "_count", "_max")
 
 
+def escape_label_value(value: str) -> str:
+    """Text-exposition-format label-value escaping: backslash, double
+    quote, and line feed — an ontology id carrying any of them must not
+    corrupt the page (one unescaped ``"`` desyncs every later sample)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping per the text format: backslash and line feed
+    (quotes are legal in HELP)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def split_sample(line: str):
+    """``(name, label_block_or_None, rest)`` for one sample line, or
+    None when the line is not a sample.  The label block is scanned
+    character-wise respecting quoted values and backslash escapes —
+    the one place ``}`` / spaces / escaped quotes inside a label value
+    are NOT structure."""
+    m = _NAME_RE.match(line)
+    if m is None:
+        return None
+    name = m.group(1)
+    i = m.end()
+    labels = None
+    if i < len(line) and line[i] == "{":
+        j = i + 1
+        in_quotes = False
+        escaped = False
+        while j < len(line):
+            c = line[j]
+            if escaped:
+                escaped = False
+            elif c == "\\":
+                escaped = True
+            elif c == '"':
+                in_quotes = not in_quotes
+            elif c == "}" and not in_quotes:
+                break
+            j += 1
+        if j >= len(line):
+            return None  # unterminated label block: not a valid sample
+        labels = line[i : j + 1]
+        i = j + 1
+    rest = line[i:].strip()
+    if not rest:
+        return None
+    return name, labels, rest
+
+
 def relabel_sample(line: str, extra: str) -> str:
     """Inject pre-formatted label pairs (``'replica="r0"'``) into one
-    sample line; comment/blank lines pass through unchanged."""
+    sample line; comment/blank/unparseable lines pass through
+    unchanged."""
     if not line or line.startswith("#"):
         return line
-    m = _SAMPLE_RE.match(line)
-    if m is None:
+    parts = split_sample(line)
+    if parts is None:
         return line
-    name, labels, value = m.groups()
-    if labels:
+    name, labels, value = parts
+    if labels and labels != "{}":
         merged = labels[:-1] + "," + extra + "}"
     else:
+        # absent OR empty block: '{,replica=...}' would be malformed
         merged = "{" + extra + "}"
     return f"{name}{merged} {value}"
 
@@ -75,10 +133,10 @@ def aggregate_expositions(pages: Dict[str, str]) -> str:
                                for kept in acc):
                         acc.append(line)
                 continue
-            m = _SAMPLE_RE.match(line)
-            if m is None:
+            parts = split_sample(line)
+            if parts is None:
                 continue
-            name = m.group(1)
+            name = parts[0]
             fam = name
             if name not in families:
                 for suf in _FAMILY_SUFFIXES:
@@ -96,12 +154,200 @@ def aggregate_expositions(pages: Dict[str, str]) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+
+
+def parse_label_block(block: str) -> Dict[str, str]:
+    """Strictly parse one ``{name="value",...}`` label block (escape
+    sequences decoded); raises ValueError on any malformation."""
+    if not block.startswith("{") or not block.endswith("}"):
+        raise ValueError(f"not a label block: {block!r}")
+    labels: Dict[str, str] = {}
+    i, n = 1, len(block)
+    while i < n - 1 or (i == n - 1 and block[i] != "}"):
+        m = _LABEL_NAME_RE.match(block, i)
+        if m is None:
+            raise ValueError(f"bad label name at {i} in {block!r}")
+        lname = m.group(0)
+        i = m.end()
+        if i >= n or block[i] != "=":
+            raise ValueError(f"missing '=' after {lname!r} in {block!r}")
+        i += 1
+        if i >= n or block[i] != '"':
+            raise ValueError(f"unquoted value for {lname!r} in {block!r}")
+        i += 1
+        buf = []
+        while i < n:
+            c = block[i]
+            if c == "\\":
+                if i + 1 >= n:
+                    raise ValueError(f"dangling escape in {block!r}")
+                nxt = block[i + 1]
+                if nxt == "n":
+                    buf.append("\n")
+                elif nxt in ('"', "\\"):
+                    buf.append(nxt)
+                else:
+                    raise ValueError(
+                        f"bad escape \\{nxt} in {block!r}"
+                    )
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                buf.append(c)
+                i += 1
+        else:
+            raise ValueError(f"unterminated value in {block!r}")
+        if lname in labels:
+            raise ValueError(f"duplicate label {lname!r} in {block!r}")
+        labels[lname] = "".join(buf)
+        if i < n and block[i] == ",":
+            i += 1
+            continue
+        if i < n and block[i] == "}":
+            break
+        raise ValueError(f"junk after value of {lname!r} in {block!r}")
+    return labels
+
+
+def _parse_value(token: str) -> float:
+    if token == "+Inf":
+        return math.inf
+    if token == "-Inf":
+        return -math.inf
+    if token == "NaN":
+        return math.nan
+    return float(token)
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """STRICT text-exposition parser — the guard a real scraper stands
+    in for.  Returns ``{family: {"help", "type", "samples":
+    [(name, labels, value)]}}`` and raises ValueError on anything a
+    conforming scraper would reject:
+
+    * a line that is neither blank, a comment, nor a well-formed sample
+      (label values scanned with escape handling);
+    * more than one HELP or TYPE line per family;
+    * a family's samples split across non-contiguous sections (the
+      aggregated fleet page must merge same-named families into ONE
+      group);
+    * histogram/summary suffix samples (``_bucket``/``_sum``/
+      ``_count``/``_max``) attached to a family of the wrong type, or a
+      histogram without its ``le="+Inf"`` bucket / ``_sum`` /
+      ``_count``.
+    """
+    families: Dict[str, dict] = {}
+    open_fam: Optional[str] = None
+    closed: set = set()
+
+    def _family(name: str) -> str:
+        # suffix samples fold into their declared histogram/summary
+        for suf in _FAMILY_SUFFIXES:
+            if name.endswith(suf):
+                base = name[: -len(suf)]
+                fam = families.get(base)
+                if fam is not None and fam["type"] in (
+                    "histogram", "summary",
+                ):
+                    return base
+        return name
+
+    def _open(fam: str, line: str) -> dict:
+        nonlocal open_fam
+        if fam != open_fam:
+            if open_fam is not None:
+                closed.add(open_fam)
+            if fam in closed:
+                raise ValueError(
+                    f"family {fam!r} re-opened after closing "
+                    f"(non-contiguous group) at: {line!r}"
+                )
+            open_fam = fam
+        return families.setdefault(
+            fam, {"help": None, "type": "untyped", "samples": []}
+        )
+
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                fam = parts[2]
+                rec = _open(fam, line)
+                if parts[1] == "HELP":
+                    if rec["help"] is not None:
+                        raise ValueError(f"duplicate HELP for {fam!r}")
+                    rec["help"] = parts[3] if len(parts) > 3 else ""
+                else:
+                    if rec["samples"]:
+                        raise ValueError(
+                            f"TYPE for {fam!r} after its samples"
+                        )
+                    if rec["type"] != "untyped":
+                        raise ValueError(f"duplicate TYPE for {fam!r}")
+                    if len(parts) < 4:
+                        raise ValueError(f"TYPE without a type: {line!r}")
+                    rec["type"] = parts[3]
+            continue
+        parts = split_sample(line)
+        if parts is None:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, block, rest = parts
+        labels = parse_label_block(block) if block else {}
+        tokens = rest.split()
+        if len(tokens) not in (1, 2):
+            raise ValueError(f"bad value/timestamp in: {line!r}")
+        value = _parse_value(tokens[0])
+        if len(tokens) == 2:
+            int(tokens[1])  # timestamp must be integral milliseconds
+        fam = _family(name)
+        rec = _open(fam, line)
+        if rec["type"] == "histogram":
+            if name == fam:
+                raise ValueError(
+                    f"bare sample {name!r} under histogram family"
+                )
+            if name.endswith("_bucket") and "le" not in labels:
+                raise ValueError(f"_bucket without le label: {line!r}")
+        elif name != fam and rec["type"] != "summary":
+            # suffixed name that didn't fold: its own untyped family
+            pass
+        rec["samples"].append((name, labels, value))
+    for fam, rec in families.items():
+        if rec["type"] != "histogram":
+            continue
+        kinds = {n[len(fam):] for n, _, _ in rec["samples"]}
+        if not {"_bucket", "_sum", "_count"} <= kinds:
+            raise ValueError(
+                f"histogram {fam!r} missing _bucket/_sum/_count"
+            )
+        series_keys = {
+            tuple(sorted((k, v) for k, v in lb.items() if k != "le"))
+            for n, lb, _ in rec["samples"] if n == fam + "_bucket"
+        }
+        inf_keys = {
+            tuple(sorted((k, v) for k, v in lb.items() if k != "le"))
+            for n, lb, _ in rec["samples"]
+            if n == fam + "_bucket" and lb.get("le") == "+Inf"
+        }
+        if series_keys != inf_keys:
+            raise ValueError(
+                f"histogram {fam!r} has a series without an le=\"+Inf\" "
+                "bucket"
+            )
+    return families
+
+
 def _labels_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
     return tuple(sorted((labels or {}).items()))
 
 
 def _fmt_labels(key: _LabelKey, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{escape_label_value(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -221,7 +467,7 @@ class Metrics:
         lines = []
         for name, series in counters.items():
             if name in helps:
-                lines.append(f"# HELP {name} {helps[name]}")
+                lines.append(f"# HELP {name} {escape_help(helps[name])}")
             lines.append(f"# TYPE {name} counter")
             for key, v in sorted(series.items()):
                 lines.append(f"{name}{_fmt_labels(key)} {_fmt_value(v)}")
@@ -232,12 +478,12 @@ class Metrics:
                 except Exception:  # a dying gauge must not kill /metrics
                     continue
             if name in helps:
-                lines.append(f"# HELP {name} {helps[name]}")
+                lines.append(f"# HELP {name} {escape_help(helps[name])}")
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {_fmt_value(v)}")
         for name, (bks, series) in hists.items():
             if name in helps:
-                lines.append(f"# HELP {name} {helps[name]}")
+                lines.append(f"# HELP {name} {escape_help(helps[name])}")
             lines.append(f"# TYPE {name} histogram")
             for key, (counts, total, cnt) in sorted(series.items()):
                 cum = 0

@@ -26,6 +26,7 @@ import time
 from typing import Dict, List, Optional
 
 from distel_tpu.config import ClassifierConfig
+from distel_tpu.obs import trace as obs_trace
 
 
 class UnknownOntology(KeyError):
@@ -69,11 +70,16 @@ class OntologyRegistry:
         spill_dir: Optional[str] = None,
         metrics=None,
         fast_path_min_concepts: Optional[int] = None,
+        flight=None,
     ):
         self.config = config or ClassifierConfig()
         self.memory_budget_bytes = memory_budget_bytes
         self.spill_dir = spill_dir
         self.metrics = metrics
+        #: optional :class:`~distel_tpu.obs.FlightRecorder` — the
+        #: registry's state transitions (evict/restore/export/adopt)
+        #: are control-plane events worth a causal record
+        self.flight = flight
         #: ops override of the fast path's scale cutoff (the compiled
         #: base program only pays off past ~32k concepts; a test or a
         #: small-corpus deployment sets 0 to force it)
@@ -93,6 +99,10 @@ class OntologyRegistry:
     def _count(self, name: str, **labels) -> None:
         if self.metrics is not None:
             self.metrics.counter_inc(name, labels or None)
+
+    def _event(self, kind: str, **fields) -> None:
+        if self.flight is not None:
+            self.flight.record(kind, **fields)
 
     def _new_inc(self):
         from distel_tpu.core.incremental import IncrementalClassifier
@@ -254,6 +264,7 @@ class OntologyRegistry:
             with self._lock:
                 self._entries.pop(oid, None)
         self._count("distel_registry_exports_total")
+        self._event("registry_export", oid=oid, spill=path)
         return {"id": oid, "texts": texts, "spill": path}
 
     def adopt(
@@ -299,6 +310,12 @@ class OntologyRegistry:
                 self._entries.pop(oid, None)
             raise
         self._count("distel_registry_adoptions_total")
+        self._event(
+            "registry_adopt",
+            oid=oid,
+            restored_from=spill_path,
+            resident=entry.inc is not None,
+        )
         self._maybe_evict(keep=oid)
         return {
             "id": oid,
@@ -316,14 +333,22 @@ class OntologyRegistry:
         from distel_tpu.core.incremental import IncrementalClassifier
 
         t0 = time.monotonic()
-        inc = IncrementalClassifier.restore(
-            entry.texts, entry.spill_path, self.config
-        )
+        with obs_trace.child_span(
+            "registry.restore", {"oid": entry.oid}
+        ):
+            inc = IncrementalClassifier.restore(
+                entry.texts, entry.spill_path, self.config
+            )
         if self.fast_path_min_concepts is not None:
             inc._FAST_PATH_MIN_CONCEPTS = self.fast_path_min_concepts
         entry.inc = inc
         entry.resident_bytes = _state_bytes(inc)
         self._count("distel_registry_restores_total")
+        self._event(
+            "registry_restore",
+            oid=entry.oid,
+            wall_s=round(time.monotonic() - t0, 4),
+        )
         if self.metrics is not None:
             self.metrics.observe(
                 "distel_registry_restore_seconds",
@@ -382,8 +407,15 @@ class OntologyRegistry:
             try:
                 if victim.inc is None:
                     continue  # raced with another evictor
+                bytes_freed = victim.resident_bytes
                 self._spill(victim)
                 self._count("distel_registry_evictions_total")
+                self._event(
+                    "registry_evict",
+                    oid=victim.oid,
+                    bytes=bytes_freed,
+                    spill=victim.spill_path,
+                )
             finally:
                 victim.lock.release()
 
@@ -402,6 +434,11 @@ class OntologyRegistry:
                     continue
                 paths.append(self._spill(entry))
                 self._count("distel_registry_shutdown_spills_total")
+                self._event(
+                    "registry_shutdown_spill",
+                    oid=entry.oid,
+                    spill=entry.spill_path,
+                )
         return paths
 
     # ---------------------------------------------------------- metrics

@@ -24,9 +24,12 @@ whole stack):
 from __future__ import annotations
 
 import collections
+import contextlib
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+from distel_tpu.obs import trace as _obs_trace
 
 
 class QueueFull(Exception):
@@ -47,7 +50,7 @@ class Request:
 
     __slots__ = (
         "key", "kind", "payload", "deadline", "enqueued", "batchable",
-        "_event", "_result", "_error", "batched",
+        "_event", "_result", "_error", "batched", "ctx", "enqueued_wall",
     )
 
     def __init__(self, key, kind, payload, deadline, batchable=False):
@@ -57,6 +60,11 @@ class Request:
         self.payload = payload
         self.deadline = deadline
         self.enqueued = time.monotonic()
+        # trace context captured at admission (the HTTP handler thread's
+        # active span): the worker re-activates it so queue-wait and
+        # lane-exec land on the request's trace
+        self.ctx = _obs_trace.current_context()
+        self.enqueued_wall = time.time() if self.ctx is not None else 0.0
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -98,6 +106,7 @@ class RequestScheduler:
         max_queue: int = 64,
         max_batch: int = 8,
         metrics=None,
+        tracer=None,
     ):
         if workers < 1 or max_queue < 1 or max_batch < 1:
             raise ValueError("workers, max_queue, max_batch must be >= 1")
@@ -105,6 +114,9 @@ class RequestScheduler:
         self.max_queue = max_queue
         self.max_batch = max_batch
         self.metrics = metrics
+        #: optional :class:`~distel_tpu.obs.SpanRecorder` — queue-wait
+        #: and lane-exec spans for requests that carried a trace context
+        self.tracer = tracer
         self._cv = threading.Condition()
         #: key → FIFO of queued requests (admission order per lane)
         self._lanes: Dict[str, collections.deque] = {}
@@ -240,12 +252,47 @@ class RequestScheduler:
                 "distel_queue_wait_seconds",
                 now - min(r.enqueued for r in live),
             )
-        try:
-            result = self._execute(key, kind, [r.payload for r in live])
-        except BaseException as e:  # noqa: BLE001 — relayed to waiters
+        # traced requests: the time spent queued becomes a span per
+        # request, and the execution wraps in a lane-exec span ACTIVATED
+        # on this worker thread — classifier phases and saturation-round
+        # events recorded during the execute nest under it.  The lane
+        # span parents on the first SAMPLED request in the batch (not
+        # the batch leader): a traced delta coalesced behind an
+        # untraced or unsampled one must not lose its exec spans
+        lead_ctx = None
+        if self.tracer is not None:
+            wall = time.time()
             for req in live:
-                req._fail(e)
-            return
+                if req.ctx is not None:
+                    if lead_ctx is None and req.ctx.sampled:
+                        lead_ctx = req.ctx
+                    self.tracer.record_complete(
+                        "scheduler.queue", req.ctx, req.enqueued_wall,
+                        wall, {"kind": req.kind, "key": key},
+                    )
+        span_cm = (
+            self.tracer.span(
+                "scheduler.lane",
+                parent=lead_ctx,
+                attrs={"kind": kind, "key": key, "batch": len(live)},
+            )
+            if lead_ctx is not None
+            else contextlib.nullcontext(_obs_trace.NOOP)
+        )
+        with span_cm as lane:
+            try:
+                result = self._execute(
+                    key, kind, [r.payload for r in live]
+                )
+            except BaseException as e:  # noqa: BLE001 — relayed to waiters
+                # caught INSIDE the span block (waiters must still be
+                # failed), so mark the span's status by hand — a failed
+                # classify must be findable by status=="error"
+                lane.set_status("error")
+                lane.set_attr("error", f"{type(e).__name__}: {e}"[:200])
+                for req in live:
+                    req._fail(e)
+                return
         for req in live:
             req.batched = len(live)
             req._resolve(result)

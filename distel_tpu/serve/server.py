@@ -19,7 +19,9 @@ closure through the checkpoint machinery before exit.
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import re
 import signal
 import threading
@@ -28,6 +30,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from distel_tpu.config import ClassifierConfig
+from distel_tpu.obs import trace as obs_trace
+from distel_tpu.obs.flight import FlightRecorder
+from distel_tpu.obs.trace import SpanRecorder, TraceContext, chrome_trace
 from distel_tpu.runtime.instrumentation import PhaseAggregate, PhaseTimer
 from distel_tpu.serve.metrics import Metrics
 from distel_tpu.serve.registry import OntologyRegistry, UnknownOntology
@@ -58,6 +63,20 @@ _ROUTES = (
      "taxonomy", "/v1/ontologies/{id}/taxonomy"),
     ("GET", re.compile(r"^/healthz/?$"), "healthz", "/healthz"),
     ("GET", re.compile(r"^/metrics/?$"), "metrics", "/metrics"),
+    ("GET", re.compile(r"^/debug/trace/?$"), "debug_trace",
+     "/debug/trace"),
+    ("GET", re.compile(r"^/debug/events/?$"), "debug_events",
+     "/debug/events"),
+)
+
+
+#: endpoints that never ROOT a trace: the router heartbeats /healthz
+#: every second and scrapers hit /metrics continuously — spans for
+#: those probes would churn the bounded ring and evict the request
+#: traces it exists to keep.  A caller that deliberately traces a
+#: probe (sampled traceparent header) is still honored.
+UNTRACED_ROOT_ENDPOINTS = frozenset(
+    ("/healthz", "/metrics", "/debug/trace", "/debug/events")
 )
 
 
@@ -81,6 +100,46 @@ def match_route(routes, method: str, path: str):
             raise HTTPError(405, f"{method} not allowed on {path}")
         return name, m.groups()
     raise HTTPError(404, f"no route for {method} {path}")
+
+
+def parse_limit(query: dict) -> Optional[int]:
+    try:
+        return int(query["limit"]) if "limit" in query else None
+    except ValueError:
+        raise HTTPError(400, "invalid limit")
+
+
+def debug_trace_response(tracer, query: dict, stitch=None):
+    """The shared ``/debug/trace`` contract (serve app and fleet
+    router): ``?trace_id=`` filters to one trace, ``?limit=`` bounds to
+    the newest N, ``?format=chrome`` returns Chrome trace-event JSON
+    (Perfetto-loadable).  ``stitch``: an optional
+    ``callable(trace_id) -> [span dicts]`` the router uses to merge the
+    replicas' spans for the queried trace (``?stitch=0`` opts out)."""
+    trace_id = query.get("trace_id") or None
+    limit = parse_limit(query)
+    spans = tracer.spans(trace_id=trace_id, limit=limit)
+    if trace_id and stitch is not None and query.get("stitch", "1") != "0":
+        spans = spans + stitch(trace_id)
+    if query.get("format") == "chrome":
+        return 200, "application/json", _dumps(chrome_trace(spans))
+    return 200, "application/json", _dumps(
+        {"service": tracer.service, "trace_id": trace_id, "spans": spans}
+    )
+
+
+def debug_events_response(flight, query: dict, match_keys=("oid",)):
+    """The shared ``/debug/events`` contract: ``?kind=`` and exact
+    field filters from ``match_keys``, ``?limit=`` bounds to the newest
+    N."""
+    limit = parse_limit(query)
+    match = {k: query[k] for k in match_keys if k in query}
+    events = flight.events(
+        kind=query.get("kind") or None, limit=limit, **match
+    )
+    return 200, "application/json", _dumps(
+        {"service": flight.service, "events": events}
+    )
 
 
 def endpoint_label(routes, path: str) -> str:
@@ -123,12 +182,22 @@ class ServeApp:
         self.default_deadline_s = deadline_s
         self.metrics = Metrics()
         self.phases = PhaseAggregate()
+        # ---- observability: per-request trace spans (config-gated
+        # sampling, bounded ring, served by /debug/trace) + the flight
+        # recorder (control-plane event log, /debug/events)
+        self.tracer = SpanRecorder(
+            service="serve", **self.config.tracer_kwargs()
+        )
+        self.flight = FlightRecorder(
+            capacity=self.config.obs_flight_capacity, service="serve"
+        )
         self.registry = OntologyRegistry(
             self.config,
             memory_budget_bytes=memory_budget_bytes,
             spill_dir=spill_dir,
             metrics=self.metrics,
             fast_path_min_concepts=fast_path_min_concepts,
+            flight=self.flight,
         )
         self.scheduler = RequestScheduler(
             self._execute,
@@ -136,6 +205,7 @@ class ServeApp:
             max_queue=max_queue,
             max_batch=max_batch,
             metrics=self.metrics,
+            tracer=self.tracer,
         )
         self.started = time.time()
         self._closed = False
@@ -433,16 +503,42 @@ class ServeApp:
         text = self.metrics.render(phase_aggregate=self.phases)
         return 200, "text/plain; version=0.0.4", text.encode("utf-8")
 
+    def _ep_debug_trace(self, *, query, body, deadline_s):
+        return debug_trace_response(self.tracer, query)
+
+    def _ep_debug_events(self, *, query, body, deadline_s):
+        return debug_events_response(self.flight, query)
+
     # --------------------------------------------------------- shutdown
 
     def close(self, final_spill: bool = True) -> List[str]:
         """Drain the scheduler and (by default) spill every resident
-        closure — the graceful-shutdown path behind SIGTERM."""
+        closure — the graceful-shutdown path behind SIGTERM.  The
+        flight recorder dumps its event log next to the spills (the
+        black box survives the process)."""
         if self._closed:
             return []
         self._closed = True
+        self.flight.record("shutdown", final_spill=final_spill)
         self.scheduler.close()
-        return self.registry.spill_all() if final_spill else []
+        spilled = self.registry.spill_all() if final_spill else []
+        self._dump_flight()
+        return spilled
+
+    def _dump_flight(self) -> Optional[str]:
+        """Write the flight-recorder JSONL into the spill dir (when one
+        is configured) — best-effort: shutdown must never fail on it."""
+        if not self.registry.spill_dir:
+            return None
+        name = self.flight.service.replace(":", "-").replace("/", "-")
+        path = os.path.join(
+            self.registry.spill_dir, f"flight_{name}.jsonl"
+        )
+        try:
+            self.flight.dump(path)
+        except OSError:
+            return None
+        return path
 
 
 def _dumps(doc) -> bytes:
@@ -485,63 +581,90 @@ def _make_handler(app: ServeApp):
             t0 = time.monotonic()
             split = urlsplit(self.path)
             path = split.path
+            endpoint = app._endpoint_label(path)
             status = 500
-            try:
-                query = dict(parse_qsl(split.query))
-                try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                except ValueError:
-                    raise HTTPError(400, "invalid Content-Length")
-                if length > MAX_BODY_BYTES:
-                    raise HTTPError(413, "request body too large")
-                if length < 0:
-                    # read(-1) would block until EOF, wedging the
-                    # handler thread on a client that never closes
-                    raise HTTPError(400, "invalid Content-Length")
-                body = self.rfile.read(length) if length else b""
-                deadline = self.headers.get("X-Distel-Deadline-S")
-                try:
-                    deadline_s = float(deadline) if deadline else None
-                except ValueError:
-                    raise HTTPError(400, "invalid X-Distel-Deadline-S")
-                status, ctype, payload = app.dispatch(
-                    method, path, query, body, deadline_s
+            # server span: continues the caller's trace via the W3C
+            # traceparent header (the router forwards its context; a
+            # bare client's request roots a new trace under the
+            # sampling decision).  Disabled tracing never parses the
+            # header, never touches the thread-local — fully off-path.
+            tracer = getattr(app, "tracer", None)
+            if tracer is not None and tracer.enabled:
+                ctx = TraceContext.from_traceparent(
+                    self.headers.get(obs_trace.TRACEPARENT_HEADER)
                 )
-                self._respond(status, ctype, payload)
-            except HTTPError as e:
-                status = e.status
-                self._respond(
-                    e.status,
-                    "application/json",
-                    _dumps({"error": e.message}),
-                    e.headers,
-                )
-            except Exception as e:  # noqa: BLE001 — last-resort 500
-                status = 500
-                try:
-                    self._respond(
-                        500,
-                        "application/json",
-                        _dumps({"error": f"{type(e).__name__}: {e}"}),
+                if ctx is None and endpoint in UNTRACED_ROOT_ENDPOINTS:
+                    # heartbeat/scrape/debug probes never root a trace
+                    span_cm = contextlib.nullcontext(obs_trace.NOOP)
+                else:
+                    span_cm = tracer.span(
+                        f"http {endpoint}",
+                        parent=ctx,
+                        attrs={"method": method, "path": path},
                     )
-                except Exception:
-                    pass
-            finally:
-                endpoint = app._endpoint_label(path)
-                # the router overrides these so its own series never
-                # collide with the replica families it re-exports
-                app.metrics.counter_inc(
-                    getattr(app, "REQUEST_METRIC", "distel_requests_total"),
-                    {"endpoint": endpoint, "code": str(status)},
-                )
-                app.metrics.observe(
-                    getattr(
-                        app, "REQUEST_SECONDS_METRIC",
-                        "distel_request_seconds",
-                    ),
-                    time.monotonic() - t0,
-                    {"endpoint": endpoint},
-                )
+            else:
+                span_cm = contextlib.nullcontext(obs_trace.NOOP)
+            with span_cm as span:
+                try:
+                    query = dict(parse_qsl(split.query))
+                    try:
+                        length = int(
+                            self.headers.get("Content-Length") or 0
+                        )
+                    except ValueError:
+                        raise HTTPError(400, "invalid Content-Length")
+                    if length > MAX_BODY_BYTES:
+                        raise HTTPError(413, "request body too large")
+                    if length < 0:
+                        # read(-1) would block until EOF, wedging the
+                        # handler thread on a client that never closes
+                        raise HTTPError(400, "invalid Content-Length")
+                    body = self.rfile.read(length) if length else b""
+                    deadline = self.headers.get("X-Distel-Deadline-S")
+                    try:
+                        deadline_s = float(deadline) if deadline else None
+                    except ValueError:
+                        raise HTTPError(400, "invalid X-Distel-Deadline-S")
+                    status, ctype, payload = app.dispatch(
+                        method, path, query, body, deadline_s
+                    )
+                    self._respond(status, ctype, payload)
+                except HTTPError as e:
+                    status = e.status
+                    self._respond(
+                        e.status,
+                        "application/json",
+                        _dumps({"error": e.message}),
+                        e.headers,
+                    )
+                except Exception as e:  # noqa: BLE001 — last-resort 500
+                    status = 500
+                    try:
+                        self._respond(
+                            500,
+                            "application/json",
+                            _dumps({"error": f"{type(e).__name__}: {e}"}),
+                        )
+                    except Exception:
+                        pass
+                finally:
+                    span.set_attr("code", status)
+                    # the router overrides these so its own series never
+                    # collide with the replica families it re-exports
+                    app.metrics.counter_inc(
+                        getattr(
+                            app, "REQUEST_METRIC", "distel_requests_total"
+                        ),
+                        {"endpoint": endpoint, "code": str(status)},
+                    )
+                    app.metrics.observe(
+                        getattr(
+                            app, "REQUEST_SECONDS_METRIC",
+                            "distel_request_seconds",
+                        ),
+                        time.monotonic() - t0,
+                        {"endpoint": endpoint},
+                    )
 
         def do_GET(self):
             self._handle("GET")

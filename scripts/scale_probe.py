@@ -112,6 +112,12 @@ def _parse_args(argv=None):
                          "carry the dispatch/retire host-time split and "
                          "the queue occupancy (inflight) so the overlap "
                          "actually won is visible per round")
+    ap.add_argument("--run-id", default=None,
+                    help="session identity stamped into every per-round "
+                         "progress line and mid-run snapshot, so chains "
+                         "of resumed scale runs correlate across "
+                         "sessions in the trace tooling (default: a "
+                         "fresh time+pid id per launch)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.resume_from and not args.execute:
@@ -160,7 +166,17 @@ def run_probe(args) -> None:
     )
     from distel_tpu.owl import parser
 
+    # session identity: every per-round progress line and snapshot
+    # carries it, so a chain of resumed runs (each its own process,
+    # hours or days apart) correlates in the trace tooling
+    run_id = args.run_id or "{}-{:x}".format(
+        time.strftime("%Y%m%dT%H%M%S"), os.getpid()
+    )
+    # the chain root: rebound to the resumed snapshot's root below, so
+    # every session of one logical scale run shares it
+    chain_run_id = run_id
     rec = {
+        "run_id": run_id,
         "n_classes": args.n_classes,
         "shape": args.shape,
         "devices": args.devices or 1,
@@ -320,8 +336,17 @@ def run_probe(args) -> None:
             snap_state, sinfo = load_snapshot_state(args.resume_from, idx=idx)
             base_derivs = sinfo["derivations"]
             base_iters = sinfo["iterations"]
+            # correlate the chain: the snapshot names the session that
+            # wrote it and the chain root every session shares
+            meta = sinfo.get("meta", {})
+            chain_run_id = (
+                meta.get("chain_run_id") or meta.get("run_id")
+                or chain_run_id
+            )
+            rec["chain_run_id"] = chain_run_id
             rec["resumed_from"] = {
                 "path": args.resume_from,
+                "run_id": meta.get("run_id"),
                 "iterations": base_iters,
                 "derivations": base_derivs,
                 "load_s": round(time.time() - t0, 1),
@@ -359,6 +384,7 @@ def run_probe(args) -> None:
                     if not first_round:
                         first_round.append(round(time.time() - t0, 1))
                     line = {
+                        "run_id": run_id,
                         "iteration": int(iteration),
                         "derivations": int(derivations),
                         "changed": bool(changed),
@@ -426,11 +452,18 @@ def run_probe(args) -> None:
                             idx=idx, converged=not changed, transposed=True,
                         ),
                         compressed=False,
+                        # the writing session plus the chain root (the
+                        # first session's id survives every resume)
+                        extra_meta={
+                            "run_id": run_id,
+                            "chain_run_id": chain_run_id,
+                        },
                     )
                     os.replace(snap_tmp, snap_path)
                     if progress:
                         with open(progress, "a") as f:
                             f.write(json.dumps({
+                                "run_id": run_id,
                                 "snapshot": snap_path,
                                 "iteration_total":
                                     base_iters + int(iteration),

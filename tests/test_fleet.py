@@ -40,14 +40,16 @@ SubClassOf(G D)
 
 
 @contextlib.contextmanager
-def fleet(tmp_path, n=2, **router_kw):
+def fleet(tmp_path, n=2, replica_config=None, **router_kw):
     """An in-process fleet: n ReplicaApps on live HTTP servers behind a
     RouterApp (threads, one shared jax runtime — the correctness rig;
-    bench_serve.py runs the real subprocess fleet)."""
+    bench_serve.py runs the real subprocess fleet).  ``replica_config``:
+    an optional ClassifierConfig for the replicas (obs knobs etc.)."""
     spill = str(tmp_path / "spill")
     apps, servers, replicas = [], [], []
     for i in range(n):
         app = ReplicaApp(
+            replica_config,
             replica_id=f"r{i}", spill_dir=spill,
             fast_path_min_concepts=0,
         )

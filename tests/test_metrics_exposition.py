@@ -1,0 +1,197 @@
+"""Prometheus text-exposition correctness: label-value escaping round
+trips (a `"` / `\\` / newline in an ontology id must not corrupt the
+page), `relabel_sample` on lines whose values carry structure
+characters, and the STRICT exposition parser that guards the router's
+aggregated /metrics page against regressions a real scraper would
+reject."""
+
+import math
+
+import pytest
+
+from distel_tpu.serve.metrics import (
+    Metrics,
+    aggregate_expositions,
+    escape_help,
+    escape_label_value,
+    parse_exposition,
+    parse_label_block,
+    relabel_sample,
+    split_sample,
+)
+
+NASTY = 'evil"id\\with\nnewline and {braces} and spaces'
+
+
+# ------------------------------------------------------------- escaping
+
+
+def test_escape_label_value_spec():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    # backslash escapes FIRST: an input already containing \n text
+    # stays distinguishable from a real newline
+    assert escape_label_value("a\\nb") == "a\\\\nb"
+
+
+def test_render_escapes_labels_and_help_round_trip():
+    m = Metrics()
+    m.describe("distel_t_total", "help with \\ backslash\nand newline")
+    m.counter_inc("distel_t_total", {"oid": NASTY}, 3.0)
+    m.observe("distel_t_seconds", 0.2, {"oid": NASTY}, buckets=(0.1, 1.0))
+    page = m.render()
+    # one line per sample: the newline in the value must be escaped
+    assert not any(
+        line.strip() and split_sample(line) is None
+        for line in page.splitlines()
+        if not line.startswith("#")
+    )
+    fams = parse_exposition(page)
+    name, labels, value = fams["distel_t_total"]["samples"][0]
+    assert labels["oid"] == NASTY
+    assert value == 3.0
+    assert "\\n" in fams["distel_t_total"]["help"]
+    hist = fams["distel_t_seconds"]
+    assert hist["type"] == "histogram"
+    bucket_labels = [
+        lb for n, lb, _ in hist["samples"] if n.endswith("_bucket")
+    ]
+    assert all(lb["oid"] == NASTY for lb in bucket_labels)
+
+
+def test_escape_help():
+    assert escape_help("a\nb\\c") == "a\\nb\\\\c"
+
+
+# ---------------------------------------------------- sample splitting
+
+
+def test_split_sample_structure_chars_in_values():
+    line = 'm{a="x} y",b="q\\"z"} 5'
+    name, block, rest = split_sample(line)
+    assert name == "m" and rest == "5"
+    labels = parse_label_block(block)
+    assert labels == {"a": "x} y", "b": 'q"z'}
+    # no labels
+    assert split_sample("m 1") == ("m", None, "1")
+    # timestamped
+    assert split_sample("m{} 1 1700000000000")[2] == "1 1700000000000"
+    # junk is not a sample
+    assert split_sample("#comment") is None
+    assert split_sample('m{a="unterminated 5') is None
+    assert split_sample("m") is None
+
+
+def test_relabel_sample_preserves_nasty_values():
+    m = Metrics()
+    m.counter_inc("distel_t_total", {"oid": NASTY})
+    line = [
+        l for l in m.render().splitlines()
+        if l.startswith("distel_t_total{")
+    ][0]
+    out = relabel_sample(line, 'replica="r\\"0"')
+    name, block, rest = split_sample(out)
+    labels = parse_label_block(block)
+    assert labels["oid"] == NASTY
+    assert labels["replica"] == 'r"0'
+    assert rest == "1"
+    # comments and unparseable lines pass through
+    assert relabel_sample("# HELP x y", "a=\"1\"") == "# HELP x y"
+    assert relabel_sample("", "a=\"1\"") == ""
+    # an EMPTY label block must not become '{,replica=...}' — the
+    # strict parser (and any real scraper) rejects that
+    out = relabel_sample("m{} 1", 'replica="r0"')
+    assert out == 'm{replica="r0"} 1'
+    parse_exposition(out + "\n")
+
+
+# ------------------------------------------------------- strict parser
+
+
+def test_parser_rejects_scraper_poison():
+    with pytest.raises(ValueError):  # non-contiguous family
+        parse_exposition("a 1\nb 2\na 3\n")
+    with pytest.raises(ValueError):  # duplicate TYPE
+        parse_exposition("# TYPE a counter\n# TYPE a counter\na 1\n")
+    with pytest.raises(ValueError):  # duplicate HELP
+        parse_exposition("# HELP a x\n# HELP a y\na 1\n")
+    with pytest.raises(ValueError):  # TYPE after samples
+        parse_exposition("a 1\n# TYPE a counter\n")
+    with pytest.raises(ValueError):  # bad escape in value
+        parse_exposition('a{x="\\q"} 1\n')
+    with pytest.raises(ValueError):  # bucket without le
+        parse_exposition(
+            "# TYPE h histogram\nh_bucket 1\nh_sum 1\nh_count 1\n"
+        )
+    with pytest.raises(ValueError):  # histogram without +Inf
+        parse_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n'
+        )
+    with pytest.raises(ValueError):  # not a sample at all
+        parse_exposition("!!!\n")
+    with pytest.raises(ValueError):  # garbage value
+        parse_exposition("a one\n")
+
+
+def test_parser_accepts_special_values():
+    fams = parse_exposition(
+        "# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 2\n'
+        "h_sum 0.3\nh_count 2\n"
+        "g +Inf\ng2 -Inf\ng3 NaN\n"
+    )
+    assert fams["h"]["type"] == "histogram"
+    assert fams["g"]["samples"][0][2] == math.inf
+    assert fams["g2"]["samples"][0][2] == -math.inf
+    assert math.isnan(fams["g3"]["samples"][0][2])
+
+
+def test_aggregated_exposition_parses_strictly():
+    """The satellite guard: merging replica pages (same families, a
+    histogram, nasty label values) must yield ONE contiguous group per
+    family with a single HELP/TYPE — validated by the strict parser."""
+    pages = {}
+    for rid in ("r0", "r1"):
+        m = Metrics()
+        m.describe("distel_req_total", "requests")
+        m.counter_inc("distel_req_total", {"oid": NASTY})
+        m.describe("distel_lat_seconds", "latency")
+        m.observe("distel_lat_seconds", 0.2, buckets=(0.1, 1.0))
+        m.gauge_set("distel_depth", 2)
+        pages[rid] = m.render()
+    agg = aggregate_expositions(pages)
+    fams = parse_exposition(agg)
+    # every sample carries its replica label, values intact
+    samples = fams["distel_req_total"]["samples"]
+    assert {lb["replica"] for _, lb, _ in samples} == {"r0", "r1"}
+    assert all(lb["oid"] == NASTY for _, lb, _ in samples)
+    # histogram suffix samples grouped under the declared family
+    hist = fams["distel_lat_seconds"]
+    assert hist["type"] == "histogram"
+    names = {n for n, _, _ in hist["samples"]}
+    assert names == {
+        "distel_lat_seconds_bucket",
+        "distel_lat_seconds_sum",
+        "distel_lat_seconds_count",
+    }
+
+
+def test_serve_app_metrics_page_parses_strictly():
+    """A live ServeApp's /metrics (counters + live gauges + frontier
+    gauge group + phase summaries) survives the strict parser."""
+    from distel_tpu.serve.server import ServeApp
+
+    app = ServeApp(fast_path_min_concepts=0)
+    try:
+        app.phases.observe("load", 0.1)  # exercise the summary path
+        status, ctype, payload = app._ep_metrics(
+            query={}, body=b"", deadline_s=None
+        )
+        assert status == 200
+        fams = parse_exposition(payload.decode())
+        assert "distel_queue_depth" in fams
+        assert fams["distel_request_phase_seconds"]["type"] == "summary"
+    finally:
+        app.close(final_spill=False)

@@ -1,0 +1,629 @@
+"""Observability tests: trace context/propagation/export units, the
+end-to-end stitched fleet trace (client → router → replica → scheduler
+lane → saturation rounds under ONE trace_id), the flight recorder's
+ordered migration and eject+respawn sequences, and the off-path
+guarantee when tracing is disabled."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from distel_tpu.obs import (
+    FlightRecorder,
+    SpanRecorder,
+    TraceContext,
+    active_span,
+    child_span,
+    chrome_trace,
+)
+from distel_tpu.serve.client import ServeClient
+from distel_tpu.serve.server import make_server
+
+from test_fleet import BASE, DELTA, fleet
+
+# ------------------------------------------------------------ trace units
+
+
+def test_traceparent_round_trip_and_malformed():
+    ctx = TraceContext.mint()
+    back = TraceContext.from_traceparent(ctx.to_traceparent())
+    assert (back.trace_id, back.span_id, back.sampled) == (
+        ctx.trace_id, ctx.span_id, True
+    )
+    off = TraceContext(ctx.trace_id, ctx.span_id, sampled=False)
+    assert off.to_traceparent().endswith("-00")
+    assert not TraceContext.from_traceparent(off.to_traceparent()).sampled
+    for bad in (
+        None, "", "garbage", "00-zz-11-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "99-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+    ):
+        assert TraceContext.from_traceparent(bad) is None, bad
+
+
+def test_span_nesting_thread_local_and_ring_bound():
+    rec = SpanRecorder(service="t", capacity=4)
+    assert active_span() is None
+    with rec.span("root") as root:
+        assert active_span() is root
+        with child_span("inner", {"k": 1}) as inner:
+            assert active_span() is inner
+            inner.add_event("ev", {"x": 2})
+        assert active_span() is root
+    assert active_span() is None
+    spans = rec.spans()
+    assert [s["name"] for s in spans] == ["inner", "root"]
+    assert spans[0]["parent_id"] == spans[1]["span_id"]
+    assert spans[0]["trace_id"] == spans[1]["trace_id"]
+    assert spans[0]["events"][0]["attrs"] == {"x": 2}
+    # ring bound: capacity 4 keeps only the newest 4
+    for i in range(10):
+        with rec.span(f"s{i}"):
+            pass
+    assert [s["name"] for s in rec.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_span_error_status_and_filtering():
+    rec = SpanRecorder(service="t")
+    with pytest.raises(RuntimeError):
+        with rec.span("boom"):
+            raise RuntimeError("nope")
+    with rec.span("fine"):
+        pass
+    spans = rec.spans()
+    assert spans[0]["status"] == "error"
+    assert "RuntimeError" in spans[0]["attrs"]["error"]
+    assert spans[1]["status"] == "ok"
+    tid = spans[1]["trace_id"]
+    assert [s["name"] for s in rec.spans(trace_id=tid)] == ["fine"]
+
+
+def test_disabled_and_unsampled_are_off_path():
+    rec = SpanRecorder(enable=False)
+    with rec.span("x") as sp:
+        assert not sp.sampled
+        assert active_span() is None  # never touches the thread-local
+        sp.add_event("ignored")
+        sp.set_attr("ignored", 1)
+    assert rec.spans() == []
+    zero = SpanRecorder(sample_rate=0.0)
+    with zero.span("root") as sp:
+        assert not sp.sampled
+    assert zero.spans() == []
+    # a sampled parent context forces the child through regardless
+    ctx = TraceContext.mint()
+    with zero.span("child", parent=ctx) as sp:
+        assert sp.sampled
+    assert zero.spans()[0]["trace_id"] == ctx.trace_id
+
+
+def test_chrome_trace_schema():
+    rec = SpanRecorder(service="svc")
+    with rec.span("outer", attrs={"a": 1}) as sp:
+        sp.add_event("tick", {"n": 3})
+    doc = chrome_trace(rec.spans())
+    json.dumps(doc)  # serializable
+    events = doc["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    completes = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert metas and completes and instants
+    assert metas[0]["name"] == "process_name"
+    assert metas[0]["args"]["name"].startswith("svc (pid ")
+    for e in completes:
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid",
+                "args"} <= set(e)
+        assert e["dur"] >= 0
+    for e in instants:
+        assert e["s"] == "t" and "ts" in e
+
+
+def test_chrome_trace_separates_services_in_one_process():
+    """Two services recording in ONE os process must land on distinct
+    Perfetto tracks (the in-process fleet rig: router + client + all
+    replicas share a pid)."""
+    a = SpanRecorder(service="router")
+    b = SpanRecorder(service="replica:r0")
+    with a.span("ra"):
+        pass
+    with b.span("rb"):
+        pass
+    doc = chrome_trace(a.spans() + b.spans())
+    metas = {
+        e["args"]["name"]: e["pid"]
+        for e in doc["traceEvents"] if e["ph"] == "M"
+    }
+    assert len(metas) == 2 and len(set(metas.values())) == 2
+    by_name = {
+        e["name"]: e["pid"]
+        for e in doc["traceEvents"] if e["ph"] == "X"
+    }
+    assert by_name["ra"] != by_name["rb"]
+
+
+def test_unsampled_root_propagates_dont_sample_downstream():
+    """sample_rate=0 at the root must suppress spans at EVERY hop (an
+    unsampled carrier context rides the traceparent header), not just
+    the first — no orphan partial traces."""
+    import urllib.request
+
+    from distel_tpu.serve.server import ServeApp
+
+    zero = SpanRecorder(service="client", sample_rate=0.0)
+    with zero.span("root") as carrier:
+        assert not carrier.sampled
+        ctx = __import__(
+            "distel_tpu.obs.trace", fromlist=["current_context"]
+        ).current_context()
+        assert ctx is not None and not ctx.sampled
+        header = ctx.to_traceparent()
+    assert header.endswith("-00")
+    assert zero.spans() == []
+    # a downstream server at FULL sampling honors the decision
+    app = ServeApp(fast_path_min_concepts=0)
+    srv = make_server(app)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = ServeClient(
+            f"http://127.0.0.1:{srv.server_address[1]}", timeout=300,
+            tracer=zero,
+        )
+        oid = c.load(BASE)["id"]
+        assert oid
+        assert c.last_trace_id is None  # nothing sampled client-side
+        assert app.tracer.spans() == []  # and none re-rooted server-side
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        app.close(final_spill=False)
+
+
+def test_flight_recorder_bound_filter_order():
+    fl = FlightRecorder(capacity=4, service="t")
+    for i in range(6):
+        fl.record("tick", i=i, oid=f"o{i % 2}")
+    evs = fl.events()
+    assert len(evs) == 4
+    assert [e["seq"] for e in evs] == [3, 4, 5, 6]  # ordered, bounded
+    assert all(e["service"] == "t" for e in evs)
+    assert [e["i"] for e in fl.events(oid="o1")] == [3, 5]
+    assert fl.events(kind="nope") == []
+    assert [e["i"] for e in fl.events(limit=2)] == [4, 5]
+
+
+def test_limit_zero_returns_nothing():
+    """limit=0 must mean zero records, not the whole ring
+    (out[-0:] is the full list)."""
+    rec = SpanRecorder(service="t")
+    with rec.span("a"):
+        pass
+    assert rec.spans(limit=0) == []
+    assert len(rec.spans(limit=1)) == 1
+    fl = FlightRecorder(service="t")
+    fl.record("k")
+    assert fl.events(limit=0) == []
+    assert len(fl.events(limit=1)) == 1
+
+
+def test_flight_event_carries_active_trace_id():
+    rec = SpanRecorder(service="t")
+    fl = FlightRecorder(service="t")
+    with rec.span("op") as sp:
+        ev = fl.record("decided", what="x")
+    assert ev["trace_id"] == sp.trace_id
+    assert "trace_id" not in fl.record("untraced")
+
+
+def test_lane_span_parents_on_first_traced_request_in_batch():
+    """A traced request coalesced BEHIND an untraced one must keep its
+    lane-exec span (the lane parents on the first traced request, not
+    the batch leader)."""
+    import threading as _threading
+
+    from distel_tpu.serve.scheduler import RequestScheduler
+
+    rec = SpanRecorder(service="t")
+    gate = _threading.Event()
+
+    def execute(key, kind, payloads):
+        if key == "blocker":
+            gate.wait(30)
+        return len(payloads)
+
+    sched = RequestScheduler(
+        execute, workers=1, max_queue=16, max_batch=8, tracer=rec
+    )
+    try:
+        blocker = sched.submit("blocker", "op", None)
+        # queue an UNTRACED batchable leader, then a traced follower
+        first = sched.submit("lane", "delta", 1, batchable=True)
+        assert first.ctx is None
+        with rec.span("client") as client_sp:
+            second = sched.submit("lane", "delta", 2, batchable=True)
+        assert second.ctx is not None
+        gate.set()
+        assert blocker.wait(30) is not None
+        assert first.wait(30) == 2 and second.wait(30) == 2  # coalesced
+        lanes = [s for s in rec.spans() if s["name"] == "scheduler.lane"]
+        assert len(lanes) == 1
+        assert lanes[0]["trace_id"] == client_sp.trace_id
+        assert lanes[0]["attrs"]["batch"] == 2
+    finally:
+        gate.set()
+        sched.close()
+
+
+def test_lane_span_skips_unsampled_carrier_leader():
+    """A SAMPLED request coalesced behind an unsampled-carrier request
+    must still get the lane span (lead pick requires ctx.sampled)."""
+    import threading as _threading
+
+    from distel_tpu.serve.scheduler import RequestScheduler
+
+    rec = SpanRecorder(service="t")
+    unsampled = SpanRecorder(service="t", sample_rate=0.0)
+    gate = _threading.Event()
+
+    def execute(key, kind, payloads):
+        if key == "blocker":
+            gate.wait(30)
+        return len(payloads)
+
+    sched = RequestScheduler(
+        execute, workers=1, max_queue=16, max_batch=8, tracer=rec
+    )
+    try:
+        blocker = sched.submit("blocker", "op", None)
+        with unsampled.span("carrier"):
+            first = sched.submit("lane", "delta", 1, batchable=True)
+        assert first.ctx is not None and not first.ctx.sampled
+        with rec.span("client") as client_sp:
+            second = sched.submit("lane", "delta", 2, batchable=True)
+        gate.set()
+        blocker.wait(30)
+        assert first.wait(30) == 2 and second.wait(30) == 2
+        lanes = [s for s in rec.spans() if s["name"] == "scheduler.lane"]
+        assert len(lanes) == 1
+        assert lanes[0]["trace_id"] == client_sp.trace_id
+    finally:
+        gate.set()
+        sched.close()
+
+
+def test_failed_lane_exec_marks_span_error():
+    """A batch whose executor raises must leave a status=="error" lane
+    span — failed requests are what /debug/trace exists to find."""
+    from distel_tpu.serve.scheduler import RequestScheduler
+
+    rec = SpanRecorder(service="t")
+
+    def execute(key, kind, payloads):
+        raise RuntimeError("boom")
+
+    sched = RequestScheduler(execute, workers=1, tracer=rec)
+    try:
+        with rec.span("client"):
+            req = sched.submit("k", "op", None)
+        with pytest.raises(RuntimeError):
+            req.wait(30)
+        deadline = time.monotonic() + 10
+        lanes = []
+        while not lanes and time.monotonic() < deadline:
+            lanes = [
+                s for s in rec.spans()
+                if s["name"] == "scheduler.lane"
+            ]
+            time.sleep(0.01)
+        assert lanes and lanes[0]["status"] == "error"
+        assert "RuntimeError" in lanes[0]["attrs"]["error"]
+    finally:
+        sched.close()
+
+
+def test_trace_rounds_gate_requires_sampled():
+    """obs.trace_rounds must not route an UNSAMPLED request (carrier
+    active, records nothing) through the observed loop — it would pay
+    the out-of-registry compile for zero visibility."""
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.core.incremental import IncrementalClassifier
+
+    cfg = ClassifierConfig(obs_trace_rounds=True)
+    inc = IncrementalClassifier(cfg)
+    with SpanRecorder(service="t", sample_rate=0.0).span("root"):
+        inc.add_text(BASE)
+    assert not inc._base_engine.frontier_rounds  # plain saturate ran
+    inc2 = IncrementalClassifier(cfg)
+    with SpanRecorder(service="t").span("root"):
+        inc2.add_text(BASE)
+    assert inc2._base_engine.frontier_rounds  # observed loop ran
+
+
+def test_probe_endpoints_never_root_spans(tmp_path):
+    """/healthz and /metrics probes (no traceparent) must not churn the
+    span ring; a deliberately traced probe is still honored."""
+    import urllib.request
+
+    from distel_tpu.serve.server import ServeApp
+
+    app = ServeApp(fast_path_min_concepts=0)
+    srv = make_server(app)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        for path in ("/healthz", "/metrics", "/debug/trace",
+                     "/debug/events"):
+            with urllib.request.urlopen(base + path, timeout=30):
+                pass
+        assert app.tracer.spans() == []
+        ctx = TraceContext.mint()
+        req = urllib.request.Request(
+            base + "/healthz",
+            headers={"traceparent": ctx.to_traceparent()},
+        )
+        with urllib.request.urlopen(req, timeout=30):
+            pass
+        spans = app.tracer.spans()
+        assert [s["name"] for s in spans] == ["http /healthz"]
+        assert spans[0]["trace_id"] == ctx.trace_id
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        app.close(final_spill=False)
+
+
+def test_obs_config_knobs_from_properties(tmp_path):
+    from distel_tpu.config import ClassifierConfig
+
+    p = tmp_path / "obs.properties"
+    p.write_text(
+        "obs.enable = false\n"
+        "obs.sample_rate = 0.25\n"
+        "obs.trace_rounds = true\n"
+        "obs.ring.capacity = 99\n"
+        "obs.flight.capacity = 7\n"
+    )
+    cfg = ClassifierConfig.from_properties(str(p))
+    assert cfg.obs_enable is False
+    assert cfg.obs_sample_rate == 0.25
+    assert cfg.obs_trace_rounds is True
+    assert cfg.obs_ring_capacity == 99
+    assert cfg.obs_flight_capacity == 7
+    kw = cfg.tracer_kwargs()
+    assert kw == {"enable": False, "sample_rate": 0.25, "capacity": 99}
+    # defaults: tracing on, full sampling, round events opt-in
+    d = ClassifierConfig()
+    assert d.obs_enable and d.obs_sample_rate == 1.0
+    assert d.obs_trace_rounds is False
+
+
+# -------------------------------------------------- end-to-end stitching
+
+
+def test_fleet_classify_yields_one_stitched_trace(tmp_path):
+    """The acceptance trace: a fleet classify request produces client,
+    router-route, replica-handler, scheduler queue-wait, lane-exec
+    spans and ≥1 saturation-round event ALL under one trace_id, and
+    the Chrome export is schema-valid JSON."""
+    from distel_tpu.config import ClassifierConfig
+
+    with fleet(
+        tmp_path, n=2,
+        replica_config=ClassifierConfig(obs_trace_rounds=True),
+    ) as (router, client, apps, servers):
+        tracer = SpanRecorder(service="client")
+        traced = ServeClient(
+            client.base_url, timeout=300, tracer=tracer
+        )
+        oid = traced.load(BASE)["id"]
+        tid = traced.last_trace_id
+        assert tid
+        # stitched view from the router (fans out to the replicas)
+        raw = traced._request("GET", f"/debug/trace?trace_id={tid}")
+        spans = raw["spans"] + tracer.spans(trace_id=tid)
+        assert all(s["trace_id"] == tid for s in spans)
+        names = " | ".join(s["name"] for s in spans)
+        for want in (
+            "client POST /v1/ontologies",   # client
+            "http /v1/ontologies",          # router route
+            "forward r",                    # router → replica hop
+            "http /fleet/load",             # replica handler
+            "scheduler.queue",              # queue wait
+            "scheduler.lane",               # lane exec
+        ):
+            assert want in names, (want, names)
+        services = {s["service"] for s in spans}
+        assert "router" in services and "client" in services
+        assert any(s.startswith("replica:") for s in services)
+        rounds = [
+            e
+            for s in spans
+            for e in s["events"]
+            if e["name"] == "saturation.round"
+        ]
+        assert rounds, "no saturation-round event on the trace"
+        assert {"tier", "density", "dispatch_s", "retire_s"} <= set(
+            rounds[0]["attrs"]
+        )
+        # lane exec parents the round events' span chain back to the
+        # replica's server span
+        by_id = {s["span_id"]: s for s in spans}
+        lane = next(s for s in spans if s["name"] == "scheduler.lane")
+        assert by_id[lane["parent_id"]]["name"] == "http /fleet/load"
+        # the replica's server span parents on the router's FORWARD
+        # hop (not the router's http span): the cross-process lineage
+        # shows where the hop's time went
+        replica_http = next(
+            s for s in spans if s["name"] == "http /fleet/load"
+        )
+        assert by_id[replica_http["parent_id"]]["name"].startswith(
+            "forward "
+        )
+        # chrome export is valid JSON a schema check accepts
+        doc = traced._request(
+            "GET", f"/debug/trace?trace_id={tid}&format=chrome"
+        )
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and len(events) >= len(raw["spans"])
+        for e in events:
+            assert "name" in e and "ph" in e and "pid" in e
+            if e["ph"] == "X":
+                assert "ts" in e and "dur" in e and "tid" in e
+
+
+def test_fleet_migration_flight_sequence(tmp_path):
+    """A forced migration leaves the complete, ordered stage sequence
+    in the router's flight recorder, retrievable from /debug/events."""
+    with fleet(tmp_path, n=2) as (router, client, apps, servers):
+        oid = client.load(BASE)["id"]
+        client.delta(oid, DELTA)
+        rec = router.migrate(oid)
+        assert rec["from"] != rec["to"]
+        doc = client._request("GET", f"/debug/events?oid={oid}")
+        kinds = [e["kind"] for e in doc["events"]]
+        want = [
+            "migrate_start", "migrate_drain", "migrate_export",
+            "migrate_adopt", "migrate_commit",
+        ]
+        idxs = [kinds.index(k) for k in want]
+        assert idxs == sorted(idxs), kinds
+        # per-stage timing recorded
+        by_kind = {e["kind"]: e for e in doc["events"]}
+        for k in ("migrate_drain", "migrate_export", "migrate_adopt",
+                  "migrate_commit"):
+            assert by_kind[k]["wall_s"] >= 0
+        assert by_kind["migrate_commit"]["src"] == rec["from"]
+        assert by_kind["migrate_commit"]["dst"] == rec["to"]
+        # kind filter works
+        only = client._request("GET", "/debug/events?kind=migrate_start")
+        assert [e["kind"] for e in only["events"]] == ["migrate_start"]
+
+
+class _RespawnSupervisor:
+    """Test double: reports the dead replica's process as gone and
+    'respawns' it onto a pre-built spare in-process replica server."""
+
+    def __init__(self, dead_rid, spare_url):
+        self.dead_rid = dead_rid
+        self.spare_url = spare_url
+        self.respawned = []
+
+    def alive(self, rid):
+        return rid != self.dead_rid
+
+    def respawn(self, rid):
+        self.respawned.append(rid)
+        return self.spare_url
+
+
+def test_fleet_eject_respawn_flight_sequence(tmp_path):
+    """A forced eject + respawn leaves the ordered heartbeat-miss →
+    eject → respawn → journal-replay/recover sequence in the flight
+    recorder."""
+    from distel_tpu.serve.fleet.replica import ReplicaApp
+
+    with fleet(
+        tmp_path, n=2, eject_failures=2
+    ) as (router, client, apps, servers):
+        oid = client.load(BASE)["id"]
+        rid = router.table.lookup(oid).rid
+        idx = int(rid[1:])
+        # a spare replica the fake supervisor "respawns" onto
+        spare = ReplicaApp(
+            replica_id=rid, spill_dir=str(tmp_path / "spill"),
+            fast_path_min_concepts=0,
+        )
+        spare_srv = make_server(spare)
+        threading.Thread(
+            target=spare_srv.serve_forever, daemon=True
+        ).start()
+        try:
+            router.supervisor = _RespawnSupervisor(
+                rid,
+                f"http://127.0.0.1:{spare_srv.server_address[1]}",
+            )
+            servers[idx].shutdown()
+            servers[idx].server_close()
+            for _ in range(2):
+                router.heartbeat_once()
+            deadline = time.monotonic() + 120
+            while not router.flight.events(kind="recover"):
+                assert time.monotonic() < deadline, "recovery never ran"
+                time.sleep(0.05)
+            kinds = [e["kind"] for e in router.flight.events()]
+            first_miss = kinds.index("heartbeat_miss")
+            order = [
+                kinds.index("eject"),
+                kinds.index("respawn"),
+                kinds.index("journal_replay"),
+                kinds.index("recover"),
+            ]
+            assert first_miss < order[0]
+            assert order == sorted(order), kinds
+            miss = router.flight.events(kind="heartbeat_miss")[0]
+            assert miss["rid"] == rid and miss["verdict"] == "dead"
+            eject = router.flight.events(kind="eject")[0]
+            assert oid in eject["stranded"]
+            respawn = router.flight.events(kind="respawn")[0]
+            assert respawn["ok"] and respawn["rid"] == rid
+            replay = router.flight.events(kind="journal_replay")[0]
+            assert replay["ok"] and replay["oid"] == oid
+            # the recovered placement answers
+            assert client.taxonomy(oid)["id"] == oid
+        finally:
+            spare_srv.shutdown()
+            spare_srv.server_close()
+            spare.close(final_spill=False)
+
+
+def test_serve_tracing_disabled_is_off_path(tmp_path):
+    """obs.enable=false: requests succeed, no spans are recorded, the
+    thread-local is never touched, and /debug/trace answers empty."""
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.serve.server import ServeApp
+
+    cfg = ClassifierConfig(obs_enable=False)
+    app = ServeApp(cfg, fast_path_min_concepts=0)
+    srv = make_server(app)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = ServeClient(
+            f"http://127.0.0.1:{srv.server_address[1]}", timeout=300
+        )
+        oid = c.load(BASE)["id"]
+        assert c.taxonomy(oid)["id"] == oid
+        assert app.tracer.spans() == []
+        doc = c._request("GET", "/debug/trace")
+        assert doc["spans"] == []
+        # an incoming traceparent is ignored entirely when disabled
+        ctx = TraceContext.mint()
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.server_address[1]}/healthz",
+            headers={"traceparent": ctx.to_traceparent()},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+        assert app.tracer.spans() == []
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        app.close(final_spill=False)
+
+
+def test_serve_flight_dump_on_close(tmp_path):
+    """Graceful close writes the flight JSONL next to the spills."""
+    from distel_tpu.serve.server import ServeApp
+
+    spill = str(tmp_path / "spill")
+    app = ServeApp(spill_dir=spill, fast_path_min_concepts=0)
+    app.flight.record("probe", n=1)
+    app.close(final_spill=True)
+    path = tmp_path / "spill" / "flight_serve.jsonl"
+    assert path.exists()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    kinds = [e["kind"] for e in lines]
+    assert "probe" in kinds and "shutdown" in kinds
