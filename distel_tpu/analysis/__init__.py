@@ -1,0 +1,45 @@
+"""distel-lint: project-specific static analysis (stdlib ``ast`` only).
+
+The reference DistEL got its concurrency safety for free: every set
+update is one atomic single-threaded Redis Lua ``eval`` (PAPER.md).
+The TPU port replaced that with hand-rolled Python locking spread over
+``serve/``, ``obs/`` and the runtime aggregates — and the worst bugs
+shipped so far (PR 6's busy-ejection split-brain, PR 4's
+gauge-named-like-a-counter metric) were invariant violations a
+project-specific static pass catches before review.  This package is
+that pass: five rules, each encoding a contract this repo actually
+carries:
+
+* :mod:`~distel_tpu.analysis.lockorder` — lock-acquisition graph from
+  ``with <lock>:`` nesting + intra-package call edges; cycles and
+  cross-module acquire-while-holding.  Runtime counterpart:
+  :mod:`distel_tpu.testing.lockdep`.
+* :mod:`~distel_tpu.analysis.purity` — traced-purity / bucket
+  invariant: functions reached from ``jax.jit`` must not close over
+  ontology arrays, host-sync traced values (``float()``/``.item()``/
+  ``np.asarray``), or Python-branch on traced values (PR 2's "a traced
+  program is a pure function of ``bucket_signature``").
+* :mod:`~distel_tpu.analysis.sharedstate` — attributes mutated both
+  inside and outside a ``with <lock>:`` block on the same class.
+* :mod:`~distel_tpu.analysis.knobs` — config-knob drift between
+  ``config.py`` fields, ``from_properties`` keys, actual reads, and
+  README documentation.
+* :mod:`~distel_tpu.analysis.metricnames` — metric-family discipline:
+  counters end ``_total``, gauges/histograms never do, and every
+  minted family is covered by the README family table.
+
+Run it: ``python -m distel_tpu.cli lint`` (committed baseline:
+``.distel-lint-baseline.json``; tier-1 CI gates on it).
+"""
+
+from distel_tpu.analysis.findings import Baseline, Finding
+from distel_tpu.analysis.project import Project
+from distel_tpu.analysis.runner import ALL_RULES, run_rules
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "Finding",
+    "Project",
+    "run_rules",
+]

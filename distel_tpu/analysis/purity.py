@@ -1,0 +1,588 @@
+"""Rule 2: traced-purity / bucket-invariant lint.
+
+PR 2's bucketing contract: a traced program is a pure function of
+``engine.bucket_signature`` — ALL ontology content rides in the
+runtime-argument pytree, so same-bucket ontologies share one compiled
+executable and one persistent-cache entry.  Nothing enforces that
+today except the comment block at the top of ``_step``; a single
+``self._fillers`` read added inside the trace silently re-specializes
+the program per ontology and the cold-start win evaporates (no test
+fails — the answers stay right, only compile sharing dies).
+
+Inside functions reached from ``jax.jit`` (direct calls, decorators,
+``self._shard_jit(fn, ...)``, lambdas), three checks:
+
+* ``traced-closure-capture`` — reads of ``self.<attr>`` where the
+  class assigns that attr an array expression, UNLESS the read is the
+  documented fallback idiom (guarded by ``<param> is None`` or by a
+  ``self._bucket`` branch — the legitimate non-bucketed path);
+* ``traced-host-sync`` — ``float()``/``int()``/``bool()`` /
+  ``.item()`` / ``np.asarray`` / ``jax.device_get`` applied to a
+  traced value (a silent device→host transfer per call inside the
+  program, or an outright tracer error at run time);
+* ``traced-python-branch`` — Python ``if``/``while`` on a traced
+  value (a tracer error under jit; a silent per-trace specialization
+  under concrete inputs).
+
+"Traced value" is a per-function taint set: the function's parameters
+(minus ``self`` and ``jax.jit`` static args) plus anything assigned
+from an expression that mentions a tainted name or calls into
+``jnp``/``lax``; ``.shape``/``.dtype``/``.ndim``/``len()`` launder the
+taint (static under trace).  Host-side controller code
+(``saturate_observed`` and friends) is never reached from a jit root,
+which is what keeps the ~80 legitimate host-side syncs in
+``rowpacked_engine.py`` out of the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from distel_tpu.analysis.findings import Finding
+from distel_tpu.analysis.project import Module, Project, _call_target
+
+RULE_CAPTURE = "traced-closure-capture"
+RULE_SYNC = "traced-host-sync"
+RULE_BRANCH = "traced-python-branch"
+
+#: callee attribute paths that mark a first argument as a jit root
+_JIT_HEADS = {("jax", "jit"), ("jit",), ("pjit",), ("jax", "pjit")}
+
+#: receivers whose module taints a call result / marks traced compute
+_TRACED_MODULES = {"jnp", "lax", "jsp"}
+
+#: taint-laundering attribute reads (static under trace)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "at", "aval"}
+
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _func_index(module: Module):
+    """Qualified name → (node, owner-class-name | None) for every
+    function in the module, including nested defs (``Class.meth``,
+    ``Class.meth.<fn>``, ``func``)."""
+    out: Dict[str, Tuple[ast.AST, Optional[str]]] = {}
+
+    def walk_fn(node, prefix: str, owner: Optional[str]):
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qid = f"{prefix}{sub.name}"
+                out[qid] = (sub, owner)
+                walk_fn(sub, qid + ".", owner)
+            elif not isinstance(sub, ast.ClassDef):
+                walk_fn(sub, prefix, owner)
+
+    for node in module.tree.body:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qid = f"{node.name}.{item.name}"
+                    out[qid] = (item, node.name)
+                    walk_fn(item, qid + ".", node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = (node, None)
+            walk_fn(node, node.name + ".", None)
+    return out
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    tgt = _call_target(node)
+    if tgt is None:
+        return False
+    if tgt in _JIT_HEADS:
+        return True
+    # functools.partial(jax.jit, static_argnums=...) as a decorator
+    if tgt[-1] == "partial" and node.args:
+        head = _call_target(ast.Call(
+            func=node.args[0], args=[], keywords=[]
+        ))
+        if head in _JIT_HEADS:
+            return True
+    # self._shard_jit(fn, ...) — the engine's shard_map+jit scaffold
+    return tgt[-1].endswith("shard_jit")
+
+
+def _jit_static_argnums(node: ast.Call) -> Set[int]:
+    for kw in node.keywords:
+        if kw.arg == "static_argnums":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            return {
+                v.value
+                for v in vals
+                if isinstance(v, ast.Constant)
+                and isinstance(v.value, int)
+            }
+    return set()
+
+
+class _RootCollector(ast.NodeVisitor):
+    """Find jit roots in a module: names of functions/methods passed to
+    ``jax.jit``-like calls (plus lambdas, resolved through their
+    bodies), with per-root static argnums."""
+
+    def __init__(self):
+        #: (owner-class-or-None, bare function name) → static argnums
+        self.roots: Dict[Tuple[Optional[str], str], Set[int]] = {}
+        self._class: Optional[str] = None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit_call(node) and node.args:
+            self._root_arg(node.args[0], _jit_static_argnums(node))
+        # functools.partial(jax.jit, ...) used as decorator is handled
+        # by the decorator scan below
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for dec in node.decorator_list:
+            if (
+                isinstance(dec, ast.Call) and _is_jit_call(dec)
+            ) or (
+                not isinstance(dec, ast.Call)
+                and _call_target(ast.Call(func=dec, args=[], keywords=[]))
+                in _JIT_HEADS
+            ):
+                statics = (
+                    _jit_static_argnums(dec)
+                    if isinstance(dec, ast.Call)
+                    else set()
+                )
+                self.roots[(self._class, node.name)] = statics
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _root_arg(self, arg: ast.expr, statics: Set[int]) -> None:
+        if isinstance(arg, ast.Lambda):
+            # the lambda body's calls are the roots (`jax.jit(lambda
+            # sp, rp: self._step(sp, rp)[:2])`)
+            for sub in ast.walk(arg.body):
+                if isinstance(sub, ast.Call):
+                    self._root_arg(sub.func, set())
+            return
+        if isinstance(arg, ast.Attribute) and isinstance(
+            arg.value, ast.Name
+        ) and arg.value.id == "self":
+            self.roots[(self._class, arg.attr)] = statics
+        elif isinstance(arg, ast.Name):
+            # local nested def or module function — key by bare name
+            # under the current class scope first, module scope second
+            self.roots[(self._class, arg.id)] = statics
+            self.roots[(None, arg.id)] = statics
+
+
+def _reached(module: Module, funcs, roots):
+    """Transitively reached functions from the jit roots via
+    ``self.x()`` / bare-name calls inside the module.  Returns
+    ``(traced: qid → root static argnums, root_qids)`` — only roots
+    carry static argnums; non-root reached functions are tainted from
+    their call sites instead."""
+    by_key: Dict[Tuple[Optional[str], str], List[str]] = {}
+    for qid, (_node, owner) in funcs.items():
+        bare = qid.rsplit(".", 1)[-1]
+        by_key.setdefault((owner, bare), []).append(qid)
+        by_key.setdefault((None, bare), []).append(qid)
+
+    # pre-seed EVERY root with its static argnums before expanding:
+    # a root reached first as another root's callee must not have its
+    # statics clobbered by the empty callee entry (the static param
+    # would read as tainted and fire bogus branch/sync findings)
+    root_qids: Set[str] = set()
+    traced: Dict[str, Set[int]] = {}
+    for key, statics in roots.items():
+        for qid in by_key.get(key, []):
+            traced[qid] = traced.get(qid, set()) | set(statics)
+            root_qids.add(qid)
+    expanded: Set[str] = set()
+    work: List[str] = list(traced)
+    while work:
+        qid = work.pop()
+        if qid in expanded:
+            continue
+        expanded.add(qid)
+        node, owner = funcs[qid]
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee_keys = []
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name
+            ) and fn.value.id == "self":
+                callee_keys.append((owner, fn.attr))
+            elif isinstance(fn, ast.Name):
+                key = (owner, fn.id)
+                callee_keys.append(
+                    key if key in by_key else (None, fn.id)
+                )
+            # function-valued arguments (lax.while_loop(cond, body),
+            # lax.cond(pred, t, f)) run traced too
+            for arg in sub.args:
+                if isinstance(arg, ast.Name):
+                    key = (owner, arg.id)
+                    if key in by_key:
+                        callee_keys.append(key)
+            for callee_key in callee_keys:
+                for cq in by_key.get(callee_key, []):
+                    if cq not in traced:
+                        traced[cq] = set()
+                    if cq not in expanded:
+                        work.append(cq)
+    return traced, root_qids, by_key
+
+
+def _seed_taints(funcs, traced, root_qids, by_key) -> Dict[str, Set[str]]:
+    """Per-function seed taint.  Roots taint every parameter (minus
+    ``self`` and jit static argnums — the values jit feeds are
+    tracers); non-root reached functions taint only the parameters
+    their call sites actually pass tainted expressions into — the
+    host-side plan builders a traced function calls AT TRACE TIME with
+    static arguments stay untainted, which is what keeps trace-time
+    Python (shape planning, table selection) out of the signal."""
+    seeds: Dict[str, Set[str]] = {}
+    for qid in traced:
+        node, _owner = funcs[qid]
+        params = _params(node)
+        if qid in root_qids:
+            statics = traced[qid]
+            # static_argnums index the signature jit actually sees:
+            # for a jit over the BOUND method (jax.jit(self._kern)),
+            # that signature excludes self — offset the def's params
+            off = 1 if params[:1] == ["self"] else 0
+            static_names = {
+                params[i + off]
+                for i in statics
+                if i + off < len(params)
+            }
+            seeds[qid] = set(params) - {"self"} - static_names
+        else:
+            seeds[qid] = set()
+    for _ in range(4):  # cross-function fixpoint
+        changed = False
+        for qid in traced:
+            node, owner = funcs[qid]
+            local = _local_taint(node, seeds[qid])
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                skip_self = False
+                if isinstance(fn, ast.Attribute) and isinstance(
+                    fn.value, ast.Name
+                ) and fn.value.id == "self":
+                    key = (owner, fn.attr)
+                    skip_self = True
+                elif isinstance(fn, ast.Name):
+                    key = (owner, fn.id)
+                    if key not in by_key:
+                        key = (None, fn.id)
+                else:
+                    continue
+                for cq in by_key.get(key, []):
+                    if cq not in traced:
+                        continue
+                    cparams = _params(funcs[cq][0])
+                    if skip_self and cparams[:1] == ["self"]:
+                        cparams = cparams[1:]
+                    for i, arg in enumerate(sub.args):
+                        if i < len(cparams) and _mentions_tainted(
+                            arg, local
+                        ):
+                            if cparams[i] not in seeds[cq]:
+                                seeds[cq].add(cparams[i])
+                                changed = True
+                    for kw in sub.keywords:
+                        if kw.arg and kw.arg in cparams and \
+                                _mentions_tainted(kw.value, local):
+                            if kw.arg not in seeds[cq]:
+                                seeds[cq].add(kw.arg)
+                                changed = True
+        if not changed:
+            break
+    return seeds
+
+
+def _params(node) -> List[str]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+_LAUNDER_CALLS = {"len", "isinstance", "hasattr", "callable", "range",
+                  "type"}
+
+
+def _mentions_tainted(node: ast.expr, tainted: Set[str]) -> bool:
+    """Does this expression carry a traced value?  ``.shape`` /
+    ``.dtype`` / ``len()`` subtrees launder the taint — they are
+    static under trace."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False  # static metadata read — whole subtree laundered
+    if isinstance(node, ast.Call):
+        tgt = _call_target(node)
+        if tgt and len(tgt) == 1 and tgt[0] in _LAUNDER_CALLS:
+            return False
+        if tgt and tgt[0] in _TRACED_MODULES:
+            return True
+    return any(
+        _mentions_tainted(child, tainted)
+        for child in ast.iter_child_nodes(node)
+        if isinstance(child, (ast.expr, ast.keyword))
+        or isinstance(child, ast.comprehension)
+    )
+
+
+def _guarded_by_fallback(
+    ancestors: List[ast.AST], params: Set[str]
+) -> bool:
+    """Is this site inside a ``<param> is None`` guard or a
+    ``self._bucket`` conditional — the legitimate non-bucketed
+    fallback idiom?"""
+    for anc in ancestors:
+        test = None
+        if isinstance(anc, (ast.If, ast.IfExp)):
+            test = anc.test
+        if test is None:
+            continue
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops
+            ):
+                names = [
+                    n.id
+                    for n in ast.walk(sub)
+                    if isinstance(n, ast.Name)
+                ]
+                if any(n in params for n in names):
+                    return True
+            if isinstance(sub, ast.Attribute) and "bucket" in sub.attr:
+                return True
+    return False
+
+
+def _local_taint(node, seed: Set[str]) -> Set[str]:
+    """Seed taint + forward assignment fixpoint within one function."""
+    tainted = set(seed)
+    for _ in range(4):  # small fixpoint: taint flows forward
+        before = len(tainted)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                if _mentions_tainted(sub.value, tainted):
+                    for tgt in sub.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                if sub.value is not None and _mentions_tainted(
+                    sub.value, tainted
+                ):
+                    for n in ast.walk(sub.target):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+class _PurityWalker:
+    """Per-traced-function checks (iterative walk keeping the ancestor
+    chain for guard detection)."""
+
+    def __init__(
+        self,
+        path: str,
+        qid: str,
+        node,
+        seed_taint: Set[str],
+        array_attrs: Set[str],
+        findings: List[Finding],
+    ):
+        self.path = path
+        self.qid = qid
+        self.node = node
+        self.array_attrs = array_attrs
+        self.findings = findings
+        self.param_names = set(_params(node))
+        self.tainted: Set[str] = set(seed_taint)
+
+    def run(self) -> None:
+        self.tainted = _local_taint(self.node, self.tainted)
+        self._walk(self.node, [])
+
+    def _walk(self, node: ast.AST, ancestors: List[ast.AST]) -> None:
+        for sub in ast.iter_child_nodes(node):
+            self._visit(sub, ancestors)
+            self._walk(sub, ancestors + [sub])
+
+    def _visit(self, sub: ast.AST, ancestors: List[ast.AST]) -> None:
+        # ---- closure capture of ontology arrays
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+            and sub.attr in self.array_attrs
+            and not _guarded_by_fallback(
+                ancestors + [sub], self.param_names
+            )
+        ):
+            self.findings.append(
+                Finding(
+                    rule=RULE_CAPTURE,
+                    path=self.path,
+                    line=sub.lineno,
+                    symbol=f"{self.qid}:self.{sub.attr}",
+                    message=(
+                        f"traced function reads self.{sub.attr} (an "
+                        "ontology-derived array) from its closure — "
+                        "bucketed programs must carry all content in "
+                        "the runtime-arg pytree"
+                    ),
+                )
+            )
+        # ---- host syncs
+        if isinstance(sub, ast.Call):
+            tgt = _call_target(sub)
+            if (
+                tgt
+                and len(tgt) == 1
+                and tgt[0] in _HOST_CASTS
+                and sub.args
+                and _mentions_tainted(sub.args[0], self.tainted)
+            ):
+                self._sync(sub, f"{tgt[0]}()")
+            elif (
+                tgt
+                and tgt[-1] in ("asarray", "array")
+                and tgt[0] == "np"
+                and sub.args
+                and _mentions_tainted(sub.args[0], self.tainted)
+            ):
+                self._sync(sub, "np." + tgt[-1])
+            elif tgt == ("jax", "device_get"):
+                self._sync(sub, "jax.device_get")
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "item"
+                and _mentions_tainted(sub.func.value, self.tainted)
+            ):
+                self._sync(sub, ".item()")
+        # ---- python branching on traced values
+        if isinstance(sub, (ast.If, ast.While)):
+            test = sub.test
+            if self._value_branch(test):
+                self.findings.append(
+                    Finding(
+                        rule=RULE_BRANCH,
+                        path=self.path,
+                        line=sub.lineno,
+                        symbol=f"{self.qid}",
+                        message=(
+                            "Python branch on a traced value "
+                            f"({ast.unparse(test)[:60]!r}) — use "
+                            "lax.cond/lax.select inside a traced "
+                            "program"
+                        ),
+                    )
+                )
+
+    def _value_branch(self, test: ast.expr) -> bool:
+        # `x is None` / `x is not None` tests are structural, not value
+        if isinstance(test, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return False
+        # `"sel4" in sa`: dict-KEY membership is pytree structure,
+        # static under trace
+        if isinstance(test, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in test.ops
+        ) and isinstance(test.left, ast.Constant):
+            return False
+        if isinstance(test, ast.BoolOp):
+            return any(self._value_branch(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(
+            test.op, ast.Not
+        ):
+            return self._value_branch(test.operand)
+        # laundered reads (.shape/len) are static
+        if isinstance(test, ast.Call):
+            tgt = _call_target(test)
+            if tgt and tgt[-1] in ("len", "isinstance", "hasattr",
+                                   "callable"):
+                return False
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+                return False
+        return _mentions_tainted(test, self.tainted)
+
+    def _sync(self, node: ast.Call, what: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=RULE_SYNC,
+                path=self.path,
+                line=node.lineno,
+                symbol=f"{self.qid}:{what}",
+                message=(
+                    f"{what} on a traced value inside a jit-reached "
+                    "function forces a host sync (or a tracer error) — "
+                    "keep the value on device or move the fold to the "
+                    "controller"
+                ),
+            )
+        )
+
+
+def check(project: Project, paths: Optional[List[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    if paths is None:
+        paths = sorted(project.modules)
+    for path in paths:
+        module = project.modules.get(path)
+        if module is None:
+            continue
+        funcs = _func_index(module)
+        collector = _RootCollector()
+        collector.visit(module.tree)
+        if not collector.roots:
+            continue
+        traced, root_qids, by_key = _reached(
+            module, funcs, collector.roots
+        )
+        seeds = _seed_taints(funcs, traced, root_qids, by_key)
+        for qid in sorted(traced):
+            node, owner = funcs[qid]
+            array_attrs: Set[str] = set()
+            if owner is not None:
+                ci = module.classes.get(owner)
+                if ci is not None:
+                    array_attrs = ci.array_attrs
+            _PurityWalker(
+                path, qid, node, seeds.get(qid, set()), array_attrs,
+                findings,
+            ).run()
+    # dedupe identical (rule, symbol, message) repeats at different
+    # lines (a loop-unrolled pattern fires once, not N times)
+    seen: Set[str] = set()
+    out: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if fp not in seen:
+            seen.add(fp)
+            out.append(f)
+    return out

@@ -11,6 +11,8 @@
   bench      run-all.sh timing loop
   serve      resident classification service (HTTP; the always-up
              Redis-cluster analog — warm programs, delta fast path)
+  lint       distel-lint: project-specific static analysis (lock
+             order, traced purity, shared state, knob/metric drift)
 
 Usage: python -m distel_tpu.cli <subcommand> [args]
 """
@@ -622,6 +624,17 @@ def cmd_fleet(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """distel-lint: the AST-based invariant checker
+    (``distel_tpu/analysis/``).  Fast (<5 s, no jax import) — tier-1
+    CI runs it before pytest as the fail-early gate; the committed
+    baseline (``.distel-lint-baseline.json``) suppresses pre-existing
+    findings, each with a one-line justification."""
+    from distel_tpu.analysis.runner import lint_main
+
+    return lint_main(args)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="distel_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -822,6 +835,30 @@ def main(argv=None) -> int:
                     help="router only: skip fetching replica spans")
     tr.add_argument("--timeout", type=float, default=30.0)
     tr.set_defaults(fn=cmd_trace)
+
+    li = sub.add_parser(
+        "lint",
+        help="distel-lint static analysis (lock order, traced "
+             "purity, shared state, config/metric drift)",
+    )
+    li.add_argument("--baseline", default=None,
+                    help="baseline JSON of justified pre-existing "
+                         "findings (default: .distel-lint-baseline"
+                         ".json at the repo root when present)")
+    li.add_argument("--json", default=None,
+                    help="write the full findings report here (CI "
+                         "uploads it on failure)")
+    li.add_argument("--rules", default=None,
+                    help="comma list to run a subset (lock-order, "
+                         "traced-purity, shared-state, knobs, "
+                         "metric-names)")
+    li.add_argument("--write-baseline", default=None,
+                    help="write current findings as a baseline "
+                         "CANDIDATE (justify each entry by hand, "
+                         "then commit)")
+    li.add_argument("--root", default=None,
+                    help="tree to analyze (default: this checkout)")
+    li.set_defaults(fn=cmd_lint)
 
     b = sub.add_parser("bench", help="timing loop on one ontology")
     b.add_argument("ontology")

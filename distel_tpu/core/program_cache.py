@@ -110,10 +110,13 @@ class ProgramCache:
         self.evictions = 0
 
     def _touch(self, key: Tuple, exe) -> None:
+        """Re-append for LRU recency.  Caller holds ``self._lock``."""
         self._programs.pop(key, None)
         self._programs[key] = exe
 
     def _evict_over_capacity(self) -> None:
+        """Drop LRU entries past capacity.  Caller holds
+        ``self._lock``."""
         while len(self._programs) > self.capacity:
             victim = next(iter(self._programs))
             self._programs.pop(victim)

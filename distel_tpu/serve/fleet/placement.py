@@ -183,7 +183,7 @@ class PlacementTable:
             return [o for o, r in self._affinity.items() if r == rid]
 
     def _touch(self, oid: str) -> None:
-        # caller holds the lock
+        """Bump the LRU tick.  Caller holds ``self._lock``."""
         self._tick += 1
         self._touched[oid] = self._tick
 

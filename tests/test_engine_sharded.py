@@ -18,6 +18,8 @@ from distel_tpu.frontend.ontology_tools import synthetic_ontology
 from distel_tpu.owl import parser
 from distel_tpu.testing.differential import diff_engine_vs_oracle
 
+from sharding_support import requires_shard_map
+
 
 def _mesh(n):
     return jax.sharding.Mesh(np.array(jax.devices()[:n]), ("c",))
@@ -100,6 +102,7 @@ def test_graft_entry_single_chip():
     assert s2.shape == args[0].shape and r2.shape == args[1].shape
 
 
+@requires_shard_map
 def test_graft_entry_multichip():
     import __graft_entry__ as ge
 

@@ -10,6 +10,8 @@ import socket
 import subprocess
 import sys
 
+from sharding_support import requires_shard_map
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -20,6 +22,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@requires_shard_map
 def test_two_process_mesh_matches_single_process():
     """Two processes, one global mesh, on a corpus large enough (3k
     classes, ~4.2k concepts, ~69k derivations) that per-shard rule work

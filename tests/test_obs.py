@@ -357,6 +357,11 @@ def test_probe_endpoints_never_root_spans(tmp_path):
                      "/debug/events"):
             with urllib.request.urlopen(base + path, timeout=30):
                 pass
+        # the handler finishes its (absent) span AFTER writing the
+        # response body, so give the server thread a beat before
+        # asserting emptiness — and poll rather than sleep before
+        # asserting presence below, for the same race the other way
+        time.sleep(0.2)
         assert app.tracer.spans() == []
         ctx = TraceContext.mint()
         req = urllib.request.Request(
@@ -365,6 +370,9 @@ def test_probe_endpoints_never_root_spans(tmp_path):
         )
         with urllib.request.urlopen(req, timeout=30):
             pass
+        deadline = time.time() + 10
+        while not app.tracer.spans() and time.time() < deadline:
+            time.sleep(0.01)
         spans = app.tracer.spans()
         assert [s["name"] for s in spans] == ["http /healthz"]
         assert spans[0]["trace_id"] == ctx.trace_id

@@ -13,6 +13,8 @@ from distel_tpu.frontend.ontology_tools import synthetic_ontology
 from distel_tpu.owl import parser
 from distel_tpu.testing.differential import diff_engine_vs_oracle
 
+from sharding_support import requires_shard_map
+
 BOTTOM_ONTO = """
 SubClassOf(Cat Mammal)
 SubClassOf(Mammal Animal)
@@ -141,6 +143,7 @@ def mesh8():
     return jax.sharding.Mesh(np.array(jax.devices()[:8]), ("c",))
 
 
+@requires_shard_map
 def test_sharded_packed_matches_local_all_rules(small, mesh8):
     norm, idx = small
     local = PackedSaturationEngine(idx).saturate()
@@ -153,6 +156,7 @@ def test_sharded_packed_matches_local_all_rules(small, mesh8):
     assert report.ok(), report.summary()
 
 
+@requires_shard_map
 def test_sharded_packed_synthetic(mesh8):
     norm, idx = _indexed(
         synthetic_ontology(
@@ -176,6 +180,7 @@ def test_sharded_packed_state_is_sharded(mesh8):
     assert shard_shapes == {(eng.nc // 8, eng.wc)}
 
 
+@requires_shard_map
 def test_sharded_packed_classifier(mesh8):
     from distel_tpu.config import ClassifierConfig
     from distel_tpu.runtime.classifier import ELClassifier

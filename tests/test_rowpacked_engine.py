@@ -16,6 +16,8 @@ from distel_tpu.testing.differential import diff_engine_vs_oracle
 
 from test_packed_engine import BOTTOM_ONTO
 
+from sharding_support import requires_shard_map
+
 
 def _indexed(text):
     norm = normalize(parser.parse(text))
@@ -197,6 +199,7 @@ def mesh8():
     return jax.sharding.Mesh(np.array(jax.devices()[:8]), ("c",))
 
 
+@requires_shard_map
 def test_sharded_rowpacked_matches_local_all_rules(small, mesh8):
     norm, idx = small
     local = RowPackedSaturationEngine(idx).saturate()
@@ -209,6 +212,7 @@ def test_sharded_rowpacked_matches_local_all_rules(small, mesh8):
     assert report.ok(), report.summary()
 
 
+@requires_shard_map
 def test_sharded_rowpacked_multiblock_sweep(small, mesh8):
     # shard-local word-block sweep (_n_sblocks > 1 under a mesh): the
     # one configuration where the shard-local width, _bw, and the
@@ -229,6 +233,7 @@ def test_sharded_rowpacked_multiblock_sweep(small, mesh8):
     assert report.ok(), report.summary()
 
 
+@requires_shard_map
 def test_sharded_rowpacked_synthetic(mesh8):
     norm, idx = _indexed(
         synthetic_ontology(
@@ -242,6 +247,7 @@ def test_sharded_rowpacked_synthetic(mesh8):
     assert (sharded.s[:n, :n] == local.s[:n, :n]).all()
 
 
+@requires_shard_map
 def test_sharded_rowpacked_public_step(mesh8):
     # step() on a mesh engine must run shard_map-structured (the matmul
     # plans are sized to the shard-local width — regression test)
@@ -278,6 +284,7 @@ def test_rowpacked_packed_resume_matches_unpacked(small):
     assert (np.asarray(a.packed_s) == np.asarray(b.packed_s)).all()
 
 
+@requires_shard_map
 def test_sharded_rowpacked_observed(small, mesh8):
     # observed mode on a mesh: same closure and derivation stream as the
     # local observed run
@@ -376,6 +383,7 @@ def test_gated_chunks_synthetic_and_chunked():
     assert obs.derivations == base.derivations
 
 
+@requires_shard_map
 def test_gated_chunks_sharded(small, mesh8):
     norm, idx = small
     base = RowPackedSaturationEngine(idx, gate_chunks=False).saturate()

@@ -24,6 +24,8 @@ from distel_tpu.frontend.ontology_tools import (
 from distel_tpu.owl import parser
 from distel_tpu.testing.differential import diff_engine_vs_oracle
 
+from sharding_support import requires_shard_map
+
 
 @pytest.fixture(scope="module")
 def corpus():
@@ -98,6 +100,7 @@ def test_scan_matches_oracle(corpus):
     assert report.ok(), report.summary()
 
 
+@requires_shard_map
 def test_scan_sharded_matches(corpus, baseline):
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices (see conftest.py)")
