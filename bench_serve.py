@@ -332,6 +332,131 @@ def _tax_diff(a: dict, b: dict, limit: int = 8) -> list:
     return diffs
 
 
+def run_delta_steady_state(
+    *,
+    deltas: int,
+    classes: int,
+    exact: bool,
+    label: str,
+) -> dict:
+    """Steady-state increment scenario (ISSUE 10): one warm base, a
+    long stream of small class-only and link-creating deltas, per-delta
+    latency split into COMPILE vs EXECUTE plus the delta-program cache
+    hit rate — the serving regime the bucketed delta programs exist
+    for.  ``exact=True`` flips the ``DISTEL_EXACT_DELTA_PROGRAMS``
+    hatch: every delta builds exact-shape programs (the pre-bucketing
+    behavior), which is the BEFORE leg of the record.
+
+    Runs a single in-process ServeApp (no fleet): the measurement
+    targets the delta plane, and replica processes would only add
+    boot noise around it."""
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+    from distel_tpu.serve.client import ServeClient
+    from distel_tpu.serve.server import ServeApp, make_server
+
+    if deltas < 2:
+        # fail BEFORE the minutes-long run: the scenario needs at
+        # least one warm and one steady delta to report a split
+        raise SystemExit(
+            f"--delta-count must be >= 2 (got {deltas})"
+        )
+    env_key = "DISTEL_EXACT_DELTA_PROGRAMS"
+    prev = os.environ.pop(env_key, None)
+    if exact:
+        os.environ[env_key] = "1"
+    app = server = None
+    try:
+        app = ServeApp(workers=1, fast_path_min_concepts=0)
+        server = make_server(app, port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        client = ServeClient(url, timeout=600)
+        oid = client.load(snomed_shaped_ontology(n_classes=classes))["id"]
+        recs = []
+        for i in range(deltas):
+            if i % 3 == 2:
+                # link-creating: the reference's property-assertion
+                # traffic shape (traffic-data-load-classify.sh)
+                text = (
+                    f"SubClassOf(SteadyLink{i} "
+                    f"ObjectSomeValuesFrom(attr0 Find{i % 5}))"
+                )
+            else:
+                text = f"SubClassOf(Steady{i} Find{i % 7})"
+            t0 = time.monotonic()
+            rec = client.delta(oid, text)
+            rec["wall_s"] = time.monotonic() - t0
+            recs.append(rec)
+
+        def agg(rs):
+            walls = sorted(r["wall_s"] for r in rs)
+            compile_s = [
+                r.get("compile_s", 0) + r.get("trace_lower_s", 0)
+                for r in rs
+            ]
+            programs = sum(r.get("delta_programs", 0) for r in rs)
+            hits = sum(r.get("delta_program_hits", 0) for r in rs)
+            return {
+                "n": len(rs),
+                "wall_p50_ms": round(1e3 * _pct(walls, 0.50), 2),
+                "wall_p99_ms": round(1e3 * _pct(walls, 0.99), 2),
+                "compile_mean_ms": round(
+                    1e3 * statistics.fmean(compile_s), 2
+                ),
+                "execute_mean_ms": round(
+                    1e3
+                    * statistics.fmean(
+                        r["wall_s"] - c for r, c in zip(rs, compile_s)
+                    ),
+                    2,
+                ),
+                "program_cache_hit_rate": round(hits / programs, 3)
+                if programs
+                else None,
+                "throughput_deltas_s": round(
+                    len(rs) / sum(r["wall_s"] for r in rs), 2
+                ),
+            }
+
+        # the first few deltas pay the once-per-bucket compiles (or,
+        # exact mode, just compile like everything else); steady state
+        # is the rest — the regime a resident tenant actually lives
+        # in.  Clamped so the steady slice is never empty at small
+        # --delta-count.
+        warm = min(max(3, deltas // 10), deltas - 1)
+        out = {
+            "scenario": label,
+            "delta_programs": "exact" if exact else "bucketed",
+            "classes": classes,
+            "deltas": deltas,
+            "fast_path": sum(r.get("path") == "fast" for r in recs),
+            "all": agg(recs),
+            "steady": agg(recs[warm:]),
+            "first_delta": {
+                "wall_ms": round(1e3 * recs[0]["wall_s"], 1),
+                "compile_ms": round(
+                    1e3
+                    * (
+                        recs[0].get("compile_s", 0)
+                        + recs[0].get("trace_lower_s", 0)
+                    ),
+                    1,
+                ),
+                "program_cache_hit": recs[0].get("program_cache_hit"),
+            },
+        }
+        return out
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if app is not None:
+            app.close()
+        os.environ.pop(env_key, None)
+        if prev is not None:
+            os.environ[env_key] = prev
+
+
 def _parallel_capacity(burn_s: float = 1.5) -> float:
     """Measured parallel speedup of 2 busy processes over 1 — the real
     scaling ceiling of this host (container quotas, SMT siblings, and
@@ -363,8 +488,9 @@ def _parallel_capacity(burn_s: float = 1.5) -> float:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--replicas", type=int, nargs="+", default=[1, 2, 4],
-                    help="replica counts to sweep (one fleet per count)")
+    ap.add_argument("--replicas", type=int, nargs="*", default=[1, 2, 4],
+                    help="replica counts to sweep (one fleet per count; "
+                         "pass none to skip the fleet sweep)")
     ap.add_argument("--clients", type=int, default=6,
                     help="concurrent simulated tenants (one ontology "
                          "each; lanes spread across replicas)")
@@ -374,6 +500,16 @@ def main(argv=None) -> int:
                     help="live-migrate one ontology mid-run (replicas "
                          ">= 2) and assert zero failures + "
                          "byte-identical taxonomy")
+    ap.add_argument("--delta-steady-state", action="store_true",
+                    help="steady-state increment scenario: a long "
+                         "stream of small deltas against one warm "
+                         "base, run twice (exact-shape then bucketed "
+                         "delta programs) — per-delta compile/execute "
+                         "split + program-cache hit rate")
+    ap.add_argument("--delta-count", type=int, default=40,
+                    help="deltas per delta-steady-state leg")
+    ap.add_argument("--delta-classes", type=int, default=600,
+                    help="base ontology size for delta-steady-state")
     ap.add_argument("--spill-dir", default=None,
                     help="fleet spill root (default: a temp dir)")
     ap.add_argument("--out", default=None,
@@ -394,7 +530,21 @@ def main(argv=None) -> int:
         )
         print(json.dumps(rec), flush=True)
         scenarios.append(rec)
-    if args.migrate_under_load:
+    if args.delta_steady_state:
+        # BEFORE leg first (exact-shape delta programs — the hatch), so
+        # the bucketed leg cannot inherit its jit caches by accident
+        # (exact programs never enter the shared registry anyway)
+        for exact in (True, False):
+            rec = run_delta_steady_state(
+                deltas=args.delta_count,
+                classes=args.delta_classes,
+                exact=exact,
+                label="delta-steady-"
+                + ("exact" if exact else "bucketed"),
+            )
+            print(json.dumps(rec), flush=True)
+            scenarios.append(rec)
+    if args.migrate_under_load and args.replicas:
         n = max(max(args.replicas), 2)
         rec = run_scenario(
             n,
@@ -420,6 +570,27 @@ def main(argv=None) -> int:
                 scaling[f"x{n}_vs_x1"] = round(
                     s["classify_throughput_ops_s"] / base, 2
                 )
+    by_delta = {
+        s.get("delta_programs"): s
+        for s in scenarios
+        if s["scenario"].startswith("delta-steady-")
+    }
+    delta_summary = None
+    if {"exact", "bucketed"} <= set(by_delta):
+        e, b = by_delta["exact"]["steady"], by_delta["bucketed"]["steady"]
+        delta_summary = {
+            "steady_p50_speedup_x": round(
+                e["wall_p50_ms"] / max(b["wall_p50_ms"], 1e-9), 2
+            ),
+            "steady_throughput_speedup_x": round(
+                b["throughput_deltas_s"]
+                / max(e["throughput_deltas_s"], 1e-9),
+                2,
+            ),
+            "compile_ms_per_delta_exact": e["compile_mean_ms"],
+            "compile_ms_per_delta_bucketed": b["compile_mean_ms"],
+            "steady_hit_rate_bucketed": b["program_cache_hit_rate"],
+        }
     doc = {
         "bench": "bench_serve",
         "metric": "aggregate_classify_throughput_ops_s",
@@ -437,8 +608,13 @@ def main(argv=None) -> int:
         ),
         "scenarios": scenarios,
         "scaling": scaling,
+        **(
+            {"delta_steady_state": delta_summary}
+            if delta_summary is not None
+            else {}
+        ),
         "zero_failed_requests": all(
-            s["failed_requests"] == 0 for s in scenarios
+            s.get("failed_requests", 0) == 0 for s in scenarios
         ),
     }
     out = json.dumps(doc, indent=2)

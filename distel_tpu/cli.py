@@ -461,6 +461,16 @@ def cmd_warmup(args) -> int:
                     ),
                     2,
                 ),
+                # the delta-plane rosters warmed alongside each base
+                # bucket (serve profile): class-only / link / mixed B
+                # programs + the cross program — the first delta after
+                # a restart is compile-free when these are > 0
+                "delta_programs": sum(
+                    r.get("delta_programs", 0) for r in recs
+                ),
+                "delta_compile_s": round(
+                    sum(r.get("delta_compile_s", 0) for r in recs), 2
+                ),
             }
         )
     )

@@ -100,9 +100,31 @@ class ClassifierConfig:
     #: ladder and all ontology content rides in runtime arguments, so
     #: same-bucket ontologies share one compiled program (in-process
     #: registry + persistent cache) — the cold-start compile fix.
-    #: Exact shapes still apply to the incremental delta fast path's
-    #: pinned-layout engines and anywhere shape_buckets is off.
+    #: Covers the incremental delta fast path too: its B/cross
+    #: programs pin the base layout verbatim but bucket their own
+    #: table/window structure, so steady-state delta traffic compiles
+    #: once per bucket per process (``DISTEL_EXACT_DELTA_PROGRAMS=1``
+    #: is the bench A/B hatch back to exact-shape delta programs).
     shape_buckets: bool = True
+    #: base-corpus concept count below which an increment takes the
+    #: full-rebuild path instead of the delta fast path.  The old
+    #: 32_768 floor was justified entirely by the fast path's fixed
+    #: compile cost (a 0.3-1 s XLA compile per delta); with bucketed
+    #: delta programs that cost is gone in the steady state, and
+    #: re-measured on this 2-core CPU host (snomed-shaped corpora,
+    #: class-only steady deltas, warm caches) the median walls TIE at
+    #: small scale — 344 concepts: fast 10.5 s vs rebuild 10.7 s; 1393
+    #: concepts: fast 48.2 s vs rebuild 49.8 s — while the rebuild leg
+    #: still pays residual compile churn (3.9 s steady max at 1393:
+    #: growing table rungs re-quantize) and an O(corpus) engine
+    #: reconstruction per increment that the fast path skips entirely.
+    #: 2048 keeps tiny corpora (where construction is trivial and the
+    #: single-engine rebuild saturate beats the multi-program
+    #: round-robin's overhead) on the rebuild path and everything else
+    #: on the compile-free fast path; on TPU hosts (ms steps, s
+    #: compiles) the fast path wins from far lower still — tune down
+    #: via ``fast.path.min.concepts``.
+    fast_path_min_concepts: int = 2_048
     #: geometric ladder step for the corpus-size axes (concept rows,
     #: link rows, rule-table rows) — padding waste per axis is bounded
     #: by (bucket_ratio - 1)
@@ -229,6 +251,10 @@ class ClassifierConfig:
             cfg.bucket_ratio = float(raw["bucket.ratio"])
         if "compile.cache.dir" in raw:
             cfg.compile_cache_dir = raw["compile.cache.dir"]
+        if "fast.path.min.concepts" in raw:
+            cfg.fast_path_min_concepts = int(
+                raw["fast.path.min.concepts"]
+            )
         if "sparse_tail.enable" in raw:
             cfg.sparse_tail = raw["sparse_tail.enable"].lower() == "true"
         if "sparse_tail.density_threshold" in raw:

@@ -310,6 +310,7 @@ class RowPackedSaturationEngine:
         window_headroom: int = 0,
         bucket: bool = False,
         bucket_ratio: float = 1.25,
+        state_dims: Optional[Tuple[int, int]] = None,
         sparse_tail: Optional[dict] = None,
         pipeline: Optional[dict] = None,
     ):
@@ -370,6 +371,18 @@ class RowPackedSaturationEngine:
         per-chunk formulation's structure is not canonicalized) and
         plain row-budget chunk spans (role-aware splitting is
         data-dependent).
+        ``state_dims``: pin the packed state layout ``(nc, nl)``
+        VERBATIM instead of deriving it from the corpus — the
+        cooperating-engine interlock of the incremental delta fast
+        path, whose delta/cross programs must interchange packed state
+        with the compiled BASE program byte-for-byte.  Combine with
+        ``l_chunk=<base.lc>`` so the link-axis chunk evening cannot
+        drift ``nl``.  With ``bucket=True`` the pinned dims come from a
+        bucketed base engine, so they are rung-derived and the delta
+        program stays a pure function of its bucket signature; the
+        caller must leave the LAST concept/link row free (``nc >
+        idx.n_concepts``, ``nl > idx.n_links``) because bucketed plans
+        reserve it as the quantization pad segments' dead target.
         ``sparse_tail``: adaptive sparse-tail execution config (None =
         off): ``saturate_observed`` then runs a host-side controller
         that switches low-frontier-density rounds onto a
@@ -412,32 +425,57 @@ class RowPackedSaturationEngine:
         self._q = lambda n: bucket_dim(n, self._bucket_ratio)
         self._q1 = lambda n: bucket_dim(n, self._bucket_ratio, floor=1)
         pad_multiple = _pad_up(max(pad_multiple, 32), 32)
-        # the packed word axis must divide evenly across shards
-        # min_concepts: a cooperating caller (the incremental path) can
-        # force concept-lane headroom beyond the corpus so later
-        # class-only deltas fit the compiled program's padding even when
-        # n_concepts lands exactly on a pad_multiple boundary
-        base_c = max(idx.n_concepts, min_concepts, 2)
-        if self._bucket:
-            # +1 before quantizing: the last concept row must be PAST
-            # the corpus — it is the reserved dead row the quantized
-            # plans' pad segments target (see _dead_c below)
-            base_c = self._q(max(idx.n_concepts + 1, min_concepts, 2))
-        self.nc = _pad_up(
-            _pad_up(base_c, pad_multiple),
-            32 * self.n_shards,
-        )
-        # min_links_pad: a cooperating engine (the incremental delta
-        # fast path) can force this engine's link-row padding up to
-        # another engine's, so their packed states interchange verbatim
-        if self._bucket:
-            self.nl = _pad_up(
-                self._q(max(idx.n_links + 1, min_links_pad, 32)), 32
-            )
+        if state_dims is not None:
+            # pinned layout (see the docstring): nc/nl verbatim from a
+            # cooperating engine.  Bucket mode additionally needs the
+            # last concept/link row past the corpus — it is the
+            # reserved dead row the quantized plans' pad segments
+            # target (see _dead_c below)
+            nc_pin, nl_pin = (int(d) for d in state_dims)
+            reserve = 1 if self._bucket else 0
+            if nc_pin % (32 * self.n_shards) or nl_pin % 32:
+                raise ValueError(
+                    f"state_dims {state_dims} must be 32-aligned "
+                    f"({32 * self.n_shards} on the concept axis under "
+                    f"{self.n_shards} shards)"
+                )
+            if nc_pin < max(idx.n_concepts + reserve, 2) or nl_pin < max(
+                idx.n_links + reserve, 32
+            ):
+                raise ValueError(
+                    f"state_dims {state_dims} too small for "
+                    f"{idx.n_concepts} concepts / {idx.n_links} links"
+                    + (" (+1 bucket dead-row reserve)" if reserve else "")
+                )
+            self.nc, self.nl = nc_pin, nl_pin
         else:
-            self.nl = max(
-                _pad_up(idx.n_links, 32), 32, _pad_up(min_links_pad, 32)
+            # the packed word axis must divide evenly across shards
+            # min_concepts: a cooperating caller (the incremental path)
+            # can force concept-lane headroom beyond the corpus so later
+            # class-only deltas fit the compiled program's padding even
+            # when n_concepts lands exactly on a pad_multiple boundary
+            base_c = max(idx.n_concepts, min_concepts, 2)
+            if self._bucket:
+                # +1 before quantizing: the last concept row must be
+                # PAST the corpus — it is the reserved dead row the
+                # quantized plans' pad segments target (see _dead_c)
+                base_c = self._q(max(idx.n_concepts + 1, min_concepts, 2))
+            self.nc = _pad_up(
+                _pad_up(base_c, pad_multiple),
+                32 * self.n_shards,
             )
+            # min_links_pad: a cooperating engine (the incremental delta
+            # fast path) can force this engine's link-row padding up to
+            # another engine's, so their packed states interchange
+            # verbatim
+            if self._bucket:
+                self.nl = _pad_up(
+                    self._q(max(idx.n_links + 1, min_links_pad, 32)), 32
+                )
+            else:
+                self.nl = max(
+                    _pad_up(idx.n_links, 32), 32, _pad_up(min_links_pad, 32)
+                )
         # reserved dead rows of the bucketed plans' pad segments: the
         # last concept row and the last PRE-EVENING link row (the link
         # axis may still grow below when lc evens out the chunk grid;
@@ -1411,11 +1449,19 @@ class RowPackedSaturationEngine:
                 "gate_rows": tuple(gate_rows),
             }
         #: build-knob record folded into the signature (options that
-        #: steer tracing without leaving a distinct shape attribute)
+        #: steer tracing without leaving a distinct shape attribute).
+        #: Bucket mode records only link_window's PRESENCE, not its
+        #: bounds: the window reaches the traced program exclusively
+        #: through the runtime-arg window slabs (offs/c01/tval), so the
+        #: incremental cross program — full CR4/CR6 tables × the
+        #: new-link window — compiles once per bucket and every later
+        #: delta's (start, stop) rides in as argument content
         self._sig_knobs = repr(
             (
                 mm_opts, l_chunk, l_chunk_cr4, temp_budget_bytes,
-                scan_group_bytes, link_window, gate_chunks,
+                scan_group_bytes,
+                (link_window is not None) if self._bucket else link_window,
+                gate_chunks,
             )
         )
         self.bucket_signature = self._compute_signature()
@@ -1583,26 +1629,48 @@ class RowPackedSaturationEngine:
             link_rows=(rp_old.shape[0], self.nl),
             link_x_words=(rp_old.shape[1], self.wc),
         )
-        if self._embed_dev_jit is None:
 
-            def embed(sp_old, rp_old):
-                sp, rp = self._initial_arrays()
-                na = min(sp_old.shape[0], self.nc)
-                nw = min(sp_old.shape[1], self.wc)
-                sp = sp.at[:na, :nw].set(
-                    sp[:na, :nw] | sp_old[:na, :nw]
-                )
-                nlr = min(rp_old.shape[0], self.nl)
-                rp = rp.at[:nlr, :nw].set(rp_old[:nlr, :nw])
-                return sp, rp
-
-            out_shardings = (
-                None
-                if self._state_sharding is None
-                else (self._state_sharding, self._state_sharding)
+        def embed(sp_old, rp_old):
+            sp, rp = self._initial_arrays()
+            na = min(sp_old.shape[0], self.nc)
+            nw = min(sp_old.shape[1], self.wc)
+            sp = sp.at[:na, :nw].set(
+                sp[:na, :nw] | sp_old[:na, :nw]
             )
+            nlr = min(rp_old.shape[0], self.nl)
+            rp = rp.at[:nlr, :nw].set(rp_old[:nlr, :nw])
+            return sp, rp
+
+        if self.mesh is None:
+            # shape-keyed registry program: the incremental fast path
+            # builds FRESH delta engines every increment, and a
+            # per-instance jit would re-trace+compile this (tiny)
+            # embed per delta — ~0.1-0.3 s of pure steady-state
+            # overhead on CPU.  The traced body depends only on the
+            # shapes and TOP_ID, so shape keying is exact.
+            key = (
+                "shape:embed", self.nc, self.nl, self.wc,
+                tuple(sp_old.shape), tuple(rp_old.shape),
+            )
+
+            def build():
+                return (
+                    jax.jit(embed)
+                    .lower(
+                        jax.ShapeDtypeStruct(sp_old.shape, jnp.uint32),
+                        jax.ShapeDtypeStruct(rp_old.shape, jnp.uint32),
+                    )
+                    .compile()
+                )
+
+            exe, _hit = PROGRAMS.get_or_build(key, build)
+            return exe(sp_old, rp_old)
+        if self._embed_dev_jit is None:
             self._embed_dev_jit = jax.jit(
-                embed, out_shardings=out_shardings
+                embed,
+                out_shardings=(
+                    self._state_sharding, self._state_sharding
+                ),
             )
         return self._embed_dev_jit(sp_old, rp_old)
 
@@ -2212,8 +2280,15 @@ class RowPackedSaturationEngine:
                     )
 
                 z = jnp.zeros((1, width), jnp.uint32)
-                acc = one(0, z) if T == 1 else lax.fori_loop(
-                    0, T, one, z
+                # T is the STATIC window-slot count; 0 = all-dead slab
+                # (see scan_contract) — contribute nothing, and never
+                # trace `one` against the empty window tables
+                acc = (
+                    z
+                    if T == 0
+                    else one(0, z)
+                    if T == 1
+                    else lax.fori_loop(0, T, one, z)
                 )
                 return (), acc[0]
 
@@ -3153,9 +3228,17 @@ class RowPackedSaturationEngine:
                         )
 
                     z = jnp.zeros((rk, wlw), jnp.uint32)
-                    acc = one(0, z) if T == 1 else lax.fori_loop(
-                        0, T, one, z
-                    )
+                    # T == 0: a bucketed slab whose spans have NO live
+                    # window anywhere (e.g. the cross program when the
+                    # new-link window satisfies none of this rule's
+                    # roles) — contribute nothing; a 0-trip fori_loop
+                    # would still TRACE `one` against the empty slabs
+                    if T == 0:
+                        acc = z
+                    else:
+                        acc = one(0, z) if T == 1 else lax.fori_loop(
+                            0, T, one, z
+                        )
                     return (), acc
 
                 xs = (
@@ -3366,6 +3449,37 @@ class RowPackedSaturationEngine:
             lax.population_count(rp & wmask[None, :]), axis=1, dtype=jnp.int32
         )
         return jnp.concatenate([bs, br])
+
+    def count_live_bits(self, sp, rp) -> jax.Array:
+        """Eager per-row live-bit counts (``_live_bits`` outside any run
+        program).  Single-device engines route it through a SHAPE-KEYED
+        registry program with the live-column mask as a runtime
+        argument: the incremental fast path counts start/final bits
+        with freshly built delta engines every increment, and a
+        per-instance ``jax.jit`` would re-trace+compile per delta —
+        measured ~0.1-0.3 s of steady-state overhead on CPU."""
+        if self.mesh is not None:
+            if self._live_bits_jit is None:
+                self._live_bits_jit = jax.jit(self._live_bits)
+            return self._live_bits_jit(sp, rp)
+        key = ("shape:live_bits", self.nc, self.nl, self.wc)
+
+        def build():
+            u32 = jnp.uint32
+            return (
+                jax.jit(
+                    lambda sp, rp, w: self._live_bits(sp, rp, wmask=w)
+                )
+                .lower(
+                    jax.ShapeDtypeStruct((self.nc, self.wc), u32),
+                    jax.ShapeDtypeStruct((self.nl, self.wc), u32),
+                    jax.ShapeDtypeStruct((self.wc,), u32),
+                )
+                .compile()
+            )
+
+        exe, _hit = PROGRAMS.get_or_build(key, build)
+        return exe(sp, rp, jnp.asarray(self._wmask))
 
     def _run(
         self, sp0, rp0, masks, max_iters: int,
@@ -3859,10 +3973,8 @@ class RowPackedSaturationEngine:
             # embed_state always allocates fresh arrays, so donation in
             # _observe_jit cannot invalidate the caller's buffers
             sp, rp = self.embed_state(*initial)
-        if self._live_bits_jit is None:
-            self._live_bits_jit = jax.jit(self._live_bits)
         init_total = _host_bit_total(
-            fetch_global(self._live_bits_jit(sp, rp))
+            fetch_global(self.count_live_bits(sp, rp))
         )
         budget = _pad_up(max_iters, self.unroll)
         cfg = (
@@ -3961,10 +4073,8 @@ class RowPackedSaturationEngine:
             sp0, rp0 = self.embed_state(*initial)
             initial = None  # the embed copied it: free the old closure
             if init_total is None:
-                if self._live_bits_jit is None:
-                    self._live_bits_jit = jax.jit(self._live_bits)
                 init_total = _host_bit_total(
-                    fetch_global(self._live_bits_jit(sp0, rp0))
+                    fetch_global(self.count_live_bits(sp0, rp0))
                 )
         if self.mesh is None:
             # AOT path: the compiled executable comes from the program

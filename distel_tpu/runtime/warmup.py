@@ -86,12 +86,29 @@ def warmup_text(
     # program (when the config enables the tier): a warmed bucket
     # serves its first low-density tail round compile-free too
     stats = engine.precompile(max_iters or config.max_iterations)
+    # the DELTA plane's low rungs (serve profile, bucketed): the
+    # canonical class-only / link-creating B programs and the cross
+    # program against this bucket's base layout, so the FIRST delta a
+    # restarted replica serves is compile-free too — not just the
+    # rebuild its load/restore pays
+    delta_recs = []
+    if profile == "serve":
+        from distel_tpu.core.incremental import warm_delta_programs
+
+        delta_recs = warm_delta_programs(
+            config, engine, idx, mesh=mesh, max_iters=max_iters
+        )
     return {
         "profile": profile,
         "concepts": idx.n_concepts,
         "links": idx.n_links,
         "wall_s": round(time.monotonic() - t0, 3),
         "sparse_programs": len(getattr(engine, "_sparse_builds", ())),
+        "delta_programs": len(delta_recs),
+        "delta_compile_s": round(
+            sum(r["compile_s"] + r["trace_lower_s"] for r in delta_recs),
+            4,
+        ),
         **stats.as_dict(),
     }
 

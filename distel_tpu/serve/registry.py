@@ -80,9 +80,10 @@ class OntologyRegistry:
         #: registry's state transitions (evict/restore/export/adopt)
         #: are control-plane events worth a causal record
         self.flight = flight
-        #: ops override of the fast path's scale cutoff (the compiled
-        #: base program only pays off past ~32k concepts; a test or a
-        #: small-corpus deployment sets 0 to force it)
+        #: ops override of the fast path's scale cutoff (None = the
+        #: config knob ``fast_path_min_concepts`` — default 2048 now
+        #: that bucketed delta programs made the steady state
+        #: compile-free; a test sets 0 to force the fast path)
         self.fast_path_min_concepts = fast_path_min_concepts
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
@@ -445,12 +446,47 @@ class OntologyRegistry:
 
     def _note_path(self, inc) -> None:
         """Bump the fast-path / rebuild counters from the increment the
-        classifier just recorded."""
-        if self.metrics is None or not inc.history:
+        classifier just recorded; fast-path increments additionally
+        export the DELTA-program plane (per-delta compile seconds +
+        delta-program registry hit/miss counts — the steady-state
+        "compile-free increments" dashboards) and stamp the delta
+        bucket signature onto the request's active classify span."""
+        if not inc.history:
             return
-        path = inc.history[-1].get("path")
+        rec = inc.history[-1]
+        path = rec.get("path")
+        span = obs_trace.active_span()
+        if span is not None and path is not None:
+            span.set_attr("increment.path", path)
+            if rec.get("delta_signature"):
+                span.set_attr("delta.bucket", rec["delta_signature"])
+                span.set_attr(
+                    "delta.program_cache_hit",
+                    bool(rec.get("program_cache_hit")),
+                )
+        if self.metrics is None:
+            return
         if path == "fast":
             self._count("distel_deltas_fast_path_total")
+            n = rec.get("delta_programs", 0)
+            if n:
+                hits = rec.get("delta_program_hits", 0)
+                if hits:
+                    self.metrics.counter_inc(
+                        "distel_delta_program_cache_hits_total",
+                        value=hits,
+                    )
+                if n - hits:
+                    self.metrics.counter_inc(
+                        "distel_delta_program_cache_misses_total",
+                        value=n - hits,
+                    )
+            st = inc.last_compile
+            if st is not None:
+                self.metrics.observe(
+                    "distel_delta_compile_seconds",
+                    st.compile_s + st.trace_lower_s,
+                )
         elif path == "rebuild":
             self._count("distel_saturation_rebuilds_total")
         self._note_compile(inc.last_compile)

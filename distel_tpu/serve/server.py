@@ -232,6 +232,20 @@ class ServeApp:
             "distel_resident_bytes", self.registry.resident_bytes
         )
         self.metrics.describe(
+            "distel_delta_compile_seconds",
+            "per-increment delta-program build seconds on the fast "
+            "path (0 in the bucketed steady state)",
+        )
+        self.metrics.describe(
+            "distel_delta_program_cache_hits_total",
+            "fast-path delta/cross programs served by the program "
+            "registry (compile-free increments)",
+        )
+        self.metrics.describe(
+            "distel_delta_program_cache_misses_total",
+            "fast-path delta/cross programs that had to compile",
+        )
+        self.metrics.describe(
             "distel_program_cache_hits_total",
             "ontology loads served by an already-compiled bucket program",
         )

@@ -1,0 +1,249 @@
+"""Bucketed delta programs (ISSUE 10): the incremental fast path's B
+(delta rows) and cross (full tables × new-link window) programs in
+shape-bucketed mode — byte-identical closures vs the exact-shape path
+and vs a cold batch run, program-registry reuse across deltas AND
+across ontologies, the exact-shape fallback at the padding-reservation
+edge, the env hatch, the promoted config knob, and the warmup plane's
+delta-roster coverage.
+
+The soundness claim under test: a bucketed delta program pins the base
+engine's state layout verbatim (the programs round-robin over ONE
+packed state) while its own table rows, gate/selection arrays and the
+link-window bounds ride as runtime arguments over ladder-quantized
+capacities — so the traced program is a pure function of the delta
+bucket signature, and steady-state delta traffic compiles once per
+bucket per process, ever."""
+
+import pytest
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.program_cache import PROGRAMS
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.owl import parser
+
+
+def _mk_base(p=""):
+    """Small base exercising every rule family a delta can extend:
+    subclass chains (CR1), an existential + axiom pair (CR3/CR4), a
+    role chain (CR6), and a second role ``s`` so an ``r ⊑ s`` delta
+    rebinds between EXISTING roles."""
+    return (
+        f"SubClassOf({p}A {p}B)\nSubClassOf({p}B {p}C)\n"
+        f"SubClassOf({p}C ObjectSomeValuesFrom(r {p}D))\n"
+        f"SubClassOf(ObjectSomeValuesFrom(r {p}D) {p}E)\n"
+        f"SubClassOf({p}E {p}F)\n"
+        f"SubObjectPropertyOf(ObjectPropertyChain(r r) r)\n"
+        f"SubClassOf({p}G ObjectSomeValuesFrom(s {p}H))\n"
+        f"SubClassOf(ObjectSomeValuesFrom(s {p}H) {p}I)\n"
+    )
+
+
+_DELTAS = {
+    "class-only": (
+        "SubClassOf(New0 A)\n"
+        "SubClassOf(ObjectIntersectionOf(F C) NewBoth)\n"
+    ),
+    "link-creating": "SubClassOf(NewL ObjectSomeValuesFrom(r B))\n",
+    "role-adding": (
+        "SubObjectPropertyOf(tNew r)\n"
+        "SubClassOf(NewR ObjectSomeValuesFrom(tNew D))\n"
+    ),
+    "rebind": (
+        "SubObjectPropertyOf(r s)\n"
+        "SubClassOf(NewQ ObjectSomeValuesFrom(r H))\n"
+    ),
+}
+
+
+def _sub_map(res, idx):
+    """Full name-keyed subsumer map — the comparison idiom of
+    test_runtime's fast-path suite (incremental and batch numberings
+    differ; names are the common key)."""
+    return {
+        idx.concept_names[x]: {
+            idx.concept_names[i]
+            for i in res.subsumers(x)
+            if i < idx.n_concepts
+        }
+        for x in range(idx.n_concepts)
+    }
+
+
+def _inc_sub_map(inc, batch_idx):
+    r = inc.last_result
+    return {
+        batch_idx.concept_names[x]: {
+            r.idx.concept_names[i]
+            for i in r.subsumers(
+                r.idx.concept_ids[batch_idx.concept_names[x]]
+            )
+            if i < r.idx.n_concepts
+        }
+        for x in range(batch_idx.n_concepts)
+    }
+
+
+def _fast_inc(**cfg_kw):
+    cfg = ClassifierConfig(fast_path_min_concepts=0, **cfg_kw)
+    return IncrementalClassifier(cfg)
+
+
+# ------------------------------------------------------ closure parity
+
+
+@pytest.mark.parametrize("kind", sorted(_DELTAS))
+def test_bucketed_delta_matches_batch(kind):
+    base, delta = _mk_base(), _DELTAS[kind]
+    inc = _fast_inc()
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    inc.add_text(delta)
+    rec = inc.history[-1]
+    assert rec["path"] == "fast", rec
+    assert inc._base_engine is base_engine  # no rebuild
+    assert rec["delta_bucketed"] is True, rec
+    batch_idx = index_ontology(normalize(parser.parse(base + delta)))
+    batch = RowPackedSaturationEngine(batch_idx).saturate()
+    assert _inc_sub_map(inc, batch_idx) == _sub_map(batch, batch_idx)
+
+
+def test_bucketed_vs_exact_delta_same_closure(monkeypatch):
+    """The A/B the bench leans on: the env hatch's exact-shape delta
+    programs and the bucketed ones produce identical subsumer maps."""
+    base = _mk_base()
+    delta = _DELTAS["link-creating"] + _DELTAS["class-only"]
+    batch_idx = index_ontology(normalize(parser.parse(base + delta)))
+    maps = {}
+    for hatch in (True, False):
+        inc = _fast_inc()
+        inc.add_text(base)
+        if hatch:
+            monkeypatch.setenv("DISTEL_EXACT_DELTA_PROGRAMS", "1")
+        else:
+            monkeypatch.delenv(
+                "DISTEL_EXACT_DELTA_PROGRAMS", raising=False
+            )
+        inc.add_text(delta)
+        rec = inc.history[-1]
+        assert rec["path"] == "fast", rec
+        assert rec["delta_bucketed"] is (not hatch), rec
+        maps[hatch] = _inc_sub_map(inc, batch_idx)
+    assert maps[True] == maps[False]
+
+
+# --------------------------------------------------- program reuse
+
+
+def test_second_same_bucket_delta_hits_registry():
+    """Steady state on ONE ontology: the second same-shape delta builds
+    zero programs — registry hit, ~0 compile."""
+    inc = _fast_inc()
+    inc.add_text(_mk_base())
+    inc.add_text("SubClassOf(Steady0 A)\n")
+    first = inc.history[-1]
+    assert first["delta_programs"] > 0, first
+    inc.add_text("SubClassOf(Steady1 A)\n")
+    rec = inc.history[-1]
+    assert rec["path"] == "fast", rec
+    assert rec["program_cache_hit"] is True, rec
+    assert rec["delta_program_hits"] == rec["delta_programs"] > 0, rec
+    assert rec["compile_s"] == 0.0 and rec["trace_lower_s"] == 0.0, rec
+
+
+def test_same_bucket_delta_shared_across_ontologies():
+    """The fleet-wide claim: a DIFFERENT ontology in the same bucket
+    reuses the delta programs compiled for the first one — same shapes,
+    different names/wiring, zero compile."""
+    inc_a = _fast_inc()
+    inc_a.add_text(_mk_base("P"))
+    inc_a.add_text("SubClassOf(PNew PA)\n")
+    sig_a = inc_a.history[-1]["delta_signature"]
+    assert sig_a
+    inc_b = _fast_inc()
+    inc_b.add_text(_mk_base("Q"))
+    inc_b.add_text("SubClassOf(QNew QA)\n")
+    rec = inc_b.history[-1]
+    assert rec["delta_signature"] == sig_a
+    assert rec["program_cache_hit"] is True, rec
+    assert rec["compile_s"] == 0.0, rec
+    # ...and the shared program computed THIS ontology's closure
+    full = _mk_base("Q") + "SubClassOf(QNew QA)\n"
+    batch_idx = index_ontology(normalize(parser.parse(full)))
+    batch = RowPackedSaturationEngine(batch_idx).saturate()
+    assert _inc_sub_map(inc_b, batch_idx) == _sub_map(batch, batch_idx)
+
+
+def test_link_capacity_edge_falls_back_exact():
+    """A delta growing the link table exactly to the base's padded
+    capacity leaves no dead link row for the quantized plans' pad
+    segments: the fast path must fall back to exact-shape programs
+    (still fast-path, still byte-identical) instead of bucketing."""
+    base = _mk_base()
+    inc = _fast_inc()
+    inc._LINK_PAD = 0  # base.nl lands on the 32 floor rung
+    inc.add_text(base)
+    base_engine = inc._base_engine
+    nl, n0 = base_engine.nl, inc._base_idx.n_links
+    assert nl == 32, nl  # premise: floor rung
+    delta = "".join(
+        f"SubClassOf(Fill{k} ObjectSomeValuesFrom(r Mk{k}))\n"
+        for k in range(nl - n0)
+    )
+    inc.add_text(delta)
+    rec = inc.history[-1]
+    assert inc.last_result.idx.n_links == nl  # premise: exactly full
+    assert rec["path"] == "fast", rec
+    assert inc._base_engine is base_engine
+    assert rec["delta_bucketed"] is False, rec
+    batch_idx = index_ontology(normalize(parser.parse(base + delta)))
+    batch = RowPackedSaturationEngine(batch_idx).saturate()
+    assert _inc_sub_map(inc, batch_idx) == _sub_map(batch, batch_idx)
+
+
+# -------------------------------------------------- knob + warmup
+
+
+def test_fast_path_threshold_is_a_config_knob(tmp_path):
+    assert ClassifierConfig().fast_path_min_concepts == 2_048
+    p = tmp_path / "t.properties"
+    p.write_text("fast.path.min.concepts = 7\n")
+    cfg = ClassifierConfig.from_properties(str(p))
+    assert cfg.fast_path_min_concepts == 7
+    inc = IncrementalClassifier(cfg)
+    assert inc._FAST_PATH_MIN_CONCEPTS == 7
+    # the config default drives path selection: a tiny corpus under
+    # the threshold rebuilds, with the knob at 0 it fast-paths
+    inc = IncrementalClassifier(ClassifierConfig())
+    inc.add_text("SubClassOf(A B)\n")
+    inc.add_text("SubClassOf(C A)\n")
+    assert inc.history[-1]["path"] == "rebuild"
+    inc = _fast_inc()
+    inc.add_text("SubClassOf(A B)\n")
+    inc.add_text("SubClassOf(C A)\n")
+    assert inc.history[-1]["path"] == "fast"
+
+
+def test_warmup_covers_first_delta_after_restart():
+    """The fleet-restart acceptance: after ``warmup_text`` (serve
+    profile) on a sample corpus, a fresh classifier's FIRST class-only
+    and link-creating deltas both run compile-free — the warmup AOTs
+    the canonical delta rosters, not just the base program."""
+    from distel_tpu.runtime import warmup
+
+    cfg = ClassifierConfig(fast_path_min_concepts=0)
+    PROGRAMS.clear()
+    rec = warmup.warmup_text(_mk_base("W"), cfg, profile="serve")
+    assert rec["delta_programs"] >= 3, rec
+    inc = IncrementalClassifier(cfg)
+    inc.add_text(_mk_base("W"))
+    assert inc.history[-1]["program_cache_hit"] is True
+    inc.add_text("SubClassOf(WNew WA)\n")
+    h = inc.history[-1]
+    assert h["program_cache_hit"] is True and h["compile_s"] == 0.0, h
+    inc.add_text("SubClassOf(WL ObjectSomeValuesFrom(r WB))\n")
+    h = inc.history[-1]
+    assert h["program_cache_hit"] is True and h["compile_s"] == 0.0, h
+    assert h["delta_program_hits"] == h["delta_programs"] == 2, h

@@ -38,6 +38,15 @@ SubClassOf(New0 ObjectSomeValuesFrom(r G))
 SubClassOf(G D)
 """
 
+#: same SHAPE as DELTA (one nf1 + one link-creating existential + one
+#: nf1) — lands in the same delta bucket, so the second increment must
+#: be a program-registry hit (ISSUE 10)
+DELTA2 = """
+SubClassOf(New1 A)
+SubClassOf(New1 ObjectSomeValuesFrom(r H))
+SubClassOf(H D)
+"""
+
 
 @contextlib.contextmanager
 def serving(**kw):
@@ -114,6 +123,19 @@ def test_serve_end_to_end_fast_path(tmp_path):
         assert "distel_requests_total" in m
         assert "distel_request_seconds_bucket" in m
         assert "distel_request_phase_seconds_count" in m
+        # the delta-program plane (ISSUE 10): build seconds observed
+        # per fast-path increment, and a SECOND same-shape delta is
+        # all registry hits — compile-free steady state, visible both
+        # in the response record and on /metrics
+        assert _metric(m, "distel_delta_compile_seconds_count") == 1
+        d2 = client.delta(oid, DELTA2)
+        assert d2["path"] == "fast"
+        assert d2["program_cache_hit"] is True, d2
+        assert d2["compile_s"] == 0.0, d2
+        m = client.metrics_text()
+        assert _metric(
+            m, "distel_delta_program_cache_hits_total"
+        ) >= d2["delta_programs"] > 0
 
         # a second query compiles nothing: rebuild counter unchanged
         client.subsumers(oid, "New0")
