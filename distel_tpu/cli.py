@@ -11,6 +11,8 @@
   bench      run-all.sh timing loop
   serve      resident classification service (HTTP; the always-up
              Redis-cluster analog — warm programs, delta fast path)
+  query      snapshot-plane reads against a serve/fleet process
+             (lock-free versioned subsumption/taxonomy answers)
   lint       distel-lint: project-specific static analysis (lock
              order, traced purity, shared state, knob/metric drift)
 
@@ -491,12 +493,18 @@ def cmd_serve(args) -> int:
         if args.memory_budget_mb is not None
         else None
     )
+    warm_budget = (
+        int(args.warm_budget_mb * (1 << 20))
+        if args.warm_budget_mb is not None
+        else None
+    )
     kw = dict(
         workers=args.workers,
         max_queue=args.max_queue,
         max_batch=args.max_batch,
         deadline_s=args.deadline_s,
         memory_budget_bytes=budget,
+        warm_budget_bytes=warm_budget,
         spill_dir=args.spill_dir,
         fast_path_min_concepts=args.fast_path_min_concepts,
         warmup_paths=args.warmup,
@@ -545,6 +553,7 @@ def cmd_fleet(args) -> int:
         ("--max-batch", args.max_batch),
         ("--deadline-s", args.deadline_s),
         ("--memory-budget-mb", args.memory_budget_mb),
+        ("--warm-budget-mb", args.warm_budget_mb),
         ("--fast-path-min-concepts", args.fast_path_min_concepts),
     ):
         if val is not None:
@@ -631,6 +640,42 @@ def cmd_fleet(args) -> int:
         ),
         flush=True,
     )
+    return 0
+
+
+def cmd_query(args) -> int:
+    """Snapshot-plane reads against a serve/fleet process: O(words)
+    subsumption tests, subsumer sets, and taxonomy slices off the
+    lock-free versioned read snapshots — never queued behind classify
+    traffic.  Every answer carries the snapshot version it came from."""
+    from distel_tpu.serve.client import ServeClient
+
+    c = ServeClient(args.url, timeout=args.timeout)
+    if args.min_version:
+        c._versions[args.oid] = args.min_version
+    try:
+        if args.op == "subsumed":
+            if len(args.names) != 2:
+                print("subsumed needs SUB SUP", file=sys.stderr)
+                return 2
+            doc = c.is_subsumed(args.oid, args.names[0], args.names[1])
+        elif args.op == "subsumers":
+            if len(args.names) != 1:
+                print("subsumers needs CLASS", file=sys.stderr)
+                return 2
+            doc = c.query_subsumers(args.oid, args.names[0])
+        elif args.op == "slice":
+            if len(args.names) != 1:
+                print("slice needs CLASS", file=sys.stderr)
+                return 2
+            doc = c.taxonomy_slice(args.oid, args.names[0])
+        else:  # version
+            doc = c.snapshot_version(args.oid)
+    except Exception as e:  # noqa: BLE001 — ops surface, fail readable
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+              file=sys.stderr)
+        return 1
+    print(json.dumps(doc, indent=2))
     return 0
 
 
@@ -727,6 +772,12 @@ def main(argv=None) -> int:
     sv.add_argument("--memory-budget-mb", type=float, default=None,
                     help="resident-closure budget; LRU ontologies spill "
                          "to --spill-dir past it")
+    sv.add_argument("--warm-budget-mb", type=float, default=None,
+                    help="host-RAM warm-tier budget: hot evictions "
+                         "demote to packed host state (promotable in "
+                         "ms, no frontend replay) before overflowing "
+                         "to compressed disk (default: config "
+                         "storage.warm.budget.mb, 0 = warm tier off)")
     sv.add_argument("--spill-dir", default=None,
                     help="snapshot directory for eviction + graceful "
                          "shutdown (required with --memory-budget-mb)")
@@ -777,6 +828,8 @@ def main(argv=None) -> int:
                     help="per-replica default request deadline")
     fl.add_argument("--memory-budget-mb", type=float, default=None,
                     help="per-replica resident-closure budget")
+    fl.add_argument("--warm-budget-mb", type=float, default=None,
+                    help="per-replica host-RAM warm-tier budget")
     fl.add_argument("--fast-path-min-concepts", type=int, default=None,
                     help="per-replica delta fast-path cutoff override")
     fl.add_argument("--warmup", nargs="*", default=None,
@@ -845,6 +898,25 @@ def main(argv=None) -> int:
                     help="router only: skip fetching replica spans")
     tr.add_argument("--timeout", type=float, default=30.0)
     tr.set_defaults(fn=cmd_trace)
+
+    qr = sub.add_parser(
+        "query",
+        help="snapshot-plane reads against a serve/fleet process "
+             "(subsumed / subsumers / slice / version)",
+    )
+    qr.add_argument("oid", help="ontology id")
+    qr.add_argument("op",
+                    choices=("subsumed", "subsumers", "slice",
+                             "version"))
+    qr.add_argument("names", nargs="*",
+                    help="subsumed: SUB SUP; subsumers/slice: CLASS")
+    qr.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="serve / fleet-router base url")
+    qr.add_argument("--min-version", type=int, default=None,
+                    help="read-your-writes watermark: refuse answers "
+                         "from snapshots older than this version")
+    qr.add_argument("--timeout", type=float, default=30.0)
+    qr.set_defaults(fn=cmd_query)
 
     li = sub.add_parser(
         "lint",

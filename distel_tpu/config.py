@@ -203,6 +203,33 @@ class ClassifierConfig:
     obs_ring_capacity: int = 2048
     #: flight-recorder event ring capacity per process
     obs_flight_capacity: int = 4096
+    #: read-optimized query plane (``serve/query/``): on every commit
+    #: the registry publishes an immutable versioned snapshot of the
+    #: packed closure, and the ``/query/*`` endpoints answer off it —
+    #: lock-free, never riding the scheduler lane.  Off: the endpoints
+    #: 404 and no per-commit host snapshot is built.
+    query_enable: bool = True
+    #: decoded-row LRU capacity per snapshot (subsumer/slice reads
+    #: decode one wire row lazily; repeat reads of hot classes hit RAM)
+    query_row_cache: int = 256
+    #: compress registry cold spills (``np.savez_compressed``) — ~8x
+    #: smaller on disk for sparse closures (941 MB → low hundreds at
+    #: 64k, see ADVICE.md) at the price of zlib wall on the spill;
+    #: restore reads both forms transparently
+    storage_compress_spills: bool = True
+    #: host-RAM warm-tier budget (MiB): hot evictions demote to a
+    #: packed host-RAM snapshot first (promotable back in milliseconds,
+    #: no frontend replay) and only overflow past this budget spills to
+    #: compressed disk.  0 disables the warm tier (evictions go
+    #: straight to cold, the pre-tiering behavior).
+    storage_warm_budget_mb: float = 0.0
+    #: halflife of the per-ontology read/write traffic EWMA that picks
+    #: eviction victims and prefetch candidates
+    storage_ewma_halflife_s: float = 60.0
+    #: period of the background tier promoter (prefetch warm/cold
+    #: entries with read traffic back toward hot while budget headroom
+    #: exists); 0 disables it
+    storage_prefetch_interval_s: float = 5.0
 
     @classmethod
     def from_properties(cls, path: str) -> "ClassifierConfig":
@@ -299,6 +326,26 @@ class ClassifierConfig:
             cfg.obs_ring_capacity = int(raw["obs.ring.capacity"])
         if "obs.flight.capacity" in raw:
             cfg.obs_flight_capacity = int(raw["obs.flight.capacity"])
+        if "query.enable" in raw:
+            cfg.query_enable = raw["query.enable"].lower() == "true"
+        if "query.row.cache" in raw:
+            cfg.query_row_cache = int(raw["query.row.cache"])
+        if "storage.compress.spills" in raw:
+            cfg.storage_compress_spills = (
+                raw["storage.compress.spills"].lower() == "true"
+            )
+        if "storage.warm.budget.mb" in raw:
+            cfg.storage_warm_budget_mb = float(
+                raw["storage.warm.budget.mb"]
+            )
+        if "storage.ewma.halflife_s" in raw:
+            cfg.storage_ewma_halflife_s = float(
+                raw["storage.ewma.halflife_s"]
+            )
+        if "storage.prefetch.interval_s" in raw:
+            cfg.storage_prefetch_interval_s = float(
+                raw["storage.prefetch.interval_s"]
+            )
         for k, v in raw.items():
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v

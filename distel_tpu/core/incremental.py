@@ -368,6 +368,10 @@ class IncrementalClassifier:
         #: serve plane can export per-delta cache-hit rates and attach
         #: the bucket signature to classify trace spans
         self.last_delta_stats: Optional[dict] = None
+        #: warm-tier parking slot (serve storage hierarchy): the index
+        #: retained by :meth:`demote` so :meth:`promote` can rebuild
+        #: without replaying the frontend
+        self._warm_idx = None
 
     def add_text(self, text: str) -> SaturationResult:
         return self.add_ontology(owl_loader.load(text))
@@ -382,6 +386,69 @@ class IncrementalClassifier:
     def _pop_state(self):
         state, self._state = self._state, None
         return state
+
+    # ------------------------------------------------- warm tier (serve)
+
+    def demote(self) -> int:
+        """Serve warm-tier hook: drop the compiled engine, its
+        program/device-constant references, and every device-resident
+        array, keeping only host state — the frontend caches
+        (normalizer memo, append-only indexer, accumulated corpus), the
+        retained index, and the packed closure as host numpy wire
+        arrays.  The result is the "warm" representation of the storage
+        hierarchy: a fraction of the hot footprint, promotable back by
+        :meth:`promote` WITHOUT the cold path's frontend replay
+        (parse → normalize → index of every text).  Returns the
+        retained packed-state bytes (the warm tier's accounting unit).
+        """
+        if self.last_result is None:
+            raise ValueError(
+                "nothing to demote: no increment has completed"
+            )
+        res = self.last_result
+        if res.transposed:
+            res._fetch()
+            state = (np.asarray(res.packed_s), np.asarray(res.packed_r))
+        else:
+            state = (np.asarray(res.s), np.asarray(res.r))
+        self._state = state
+        self._warm_idx = res.idx
+        self._base_engine = self._base_idx = None
+        self.last_result = None
+        self.last_compile = None
+        self.last_delta_stats = None
+        return int(state[0].nbytes + state[1].nbytes)
+
+    def promote(self) -> SaturationResult:
+        """Warm→hot: rebuild the engine over the index :meth:`demote`
+        retained and warm-start from the host packed state — one quiet
+        saturation pass under a (normally registry-cached) bucket
+        program.  No parse, no normalize, no re-index: the milliseconds
+        restore the warm tier exists for, vs the cold restore's full
+        frontend replay."""
+        if self._warm_idx is None:
+            raise ValueError("promote needs a prior demote")
+        idx, self._warm_idx = self._warm_idx, None
+        result = self._full_rebuild(idx)
+        if result.transposed:
+            self._state = (result.packed_s, result.packed_r)
+        else:
+            self._state = (result.s, result.r)
+        self.history.append(
+            {
+                "increment": self.increment,
+                "iterations": result.iterations,
+                "new_derivations": result.derivations,
+                "path": "promote",
+                **(
+                    self.last_compile.as_dict()
+                    if self.last_compile is not None
+                    else {}
+                ),
+            }
+        )
+        self.last_result = result
+        return result
 
     def _ingest(self, onto):
         """Frontend half of an increment: normalize the batch under the
@@ -531,8 +598,9 @@ class IncrementalClassifier:
 
         # the stale base engine's device constants and compiled programs
         # are useless once a rebuild starts — free them before the new
-        # engine allocates
+        # engine allocates (and a retained warm-tier index is now stale)
         self._base_engine = self._base_idx = None
+        self._warm_idx = None
         # reservations for later deltas (see rebuild_engine): concept-
         # lane headroom even when n_concepts lands exactly on a pad
         # boundary, link rows for the cross-term path's new links, and

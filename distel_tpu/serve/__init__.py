@@ -10,28 +10,43 @@ paying parse+compile per invocation.
 Layout::
 
     registry.py   warm-program registry — one IncrementalClassifier per
-                  loaded ontology, LRU eviction under a memory budget
-                  with snapshot-to-disk spill (runtime/checkpoint)
+                  loaded ontology, traffic-driven demotion through the
+                  hot/warm/cold storage tiers under a memory budget,
+                  per-commit read-snapshot publishing
     scheduler.py  bounded-queue request scheduler — per-ontology
                   serialization, cross-ontology concurrency, delta
                   batching, admission control, deadlines
+    query/        read-optimized query plane: lock-free versioned
+                  immutable closure snapshots behind the /query/*
+                  endpoints (reads never ride the scheduler lane)
+    storage/      tier policy: per-ontology read/write EWMA picking
+                  eviction victims and prefetch candidates
     metrics.py    Prometheus-text counters/gauges/summaries over the
                   registry/scheduler/instrumentation signals
     server.py     ThreadingHTTPServer app: the /v1 endpoints, /healthz,
                   /metrics, graceful SIGTERM shutdown with final spill
     client.py     tiny stdlib client (urllib) used by the tests, with
                   opt-in jittered retry/backoff honoring Retry-After
+                  plus typed snapshot-read helpers carrying a
+                  min_version watermark (read-your-writes)
     fleet/        horizontal scale-out: router + shared-nothing replica
                   processes — affinity placement, live ontology
                   migration over the registry's spill/restore wire,
-                  heartbeat eject-and-respawn, queue-depth rebalance
+                  heartbeat eject-and-respawn, queue-depth rebalance,
+                  read-snapshot replication + /query fan-out
 
 Entry points: ``python -m distel_tpu.cli serve --port 8080`` (one
 process) and ``python -m distel_tpu.cli fleet --replicas 4
 --spill-dir /var/tmp/distel-spill`` (router + replicas).
 """
 
-from distel_tpu.serve.registry import OntologyRegistry
+from distel_tpu.serve.query import (
+    OntologySnapshot,
+    SnapshotMiss,
+    SnapshotStore,
+    StaleSnapshot,
+)
+from distel_tpu.serve.registry import ColdSpillCorrupted, OntologyRegistry
 from distel_tpu.serve.scheduler import (
     Deadline,
     QueueFull,
@@ -41,11 +56,16 @@ from distel_tpu.serve.scheduler import (
 from distel_tpu.serve.server import ServeApp, make_server
 
 __all__ = [
+    "ColdSpillCorrupted",
     "Deadline",
     "OntologyRegistry",
+    "OntologySnapshot",
     "QueueFull",
     "RequestScheduler",
     "ServeApp",
     "ShuttingDown",
+    "SnapshotMiss",
+    "SnapshotStore",
+    "StaleSnapshot",
     "make_server",
 ]

@@ -84,6 +84,12 @@ class ServeClient:
         self.tracer = tracer
         #: trace id of the most recent traced request (None untraced)
         self.last_trace_id: Optional[str] = None
+        #: per-ontology snapshot-version watermark: the highest version
+        #: seen in any write ack or read response.  Read helpers thread
+        #: it back as ``min_version``, which buys monotonic reads AND
+        #: read-your-writes across a fanned-out fleet (a lagging read
+        #: replica answers 412 and the router retries the primary).
+        self._versions: dict = {}
 
     # ------------------------------------------------------------- http
 
@@ -192,18 +198,35 @@ class ServeClient:
 
     # -------------------------------------------------------------- API
 
+    def _note_version(self, oid: str, doc) -> None:
+        if isinstance(doc, dict) and isinstance(doc.get("version"), int):
+            v = doc["version"]
+            if v > self._versions.get(oid, 0):
+                self._versions[oid] = v
+
+    def watermark(self, oid: str) -> int:
+        """Highest snapshot version this client has observed for the
+        ontology (0 = none yet) — what read helpers send as
+        ``min_version``."""
+        return self._versions.get(oid, 0)
+
     def load(self, text: str, deadline_s: Optional[float] = None) -> dict:
-        return self._request(
+        rec = self._request(
             "POST", "/v1/ontologies", {"text": text}, deadline_s
         )
+        if isinstance(rec, dict) and "id" in rec:
+            self._note_version(rec["id"], rec)
+        return rec
 
     def delta(
         self, oid: str, text: str, deadline_s: Optional[float] = None
     ) -> dict:
-        return self._request(
+        rec = self._request(
             "POST", f"/v1/ontologies/{oid}/deltas", {"text": text},
             deadline_s,
         )
+        self._note_version(oid, rec)
+        return rec
 
     def subsumers(
         self, oid: str, cls: str, deadline_s: Optional[float] = None
@@ -221,6 +244,70 @@ class ServeClient:
         return self._request(
             "GET", f"/v1/ontologies/{oid}/taxonomy", None, deadline_s
         )
+
+    # ------------------------------------- snapshot-plane read helpers
+
+    def _query_read(
+        self,
+        oid: str,
+        op: str,
+        params: dict,
+        deadline_s: Optional[float],
+    ) -> dict:
+        from urllib.parse import urlencode
+
+        q = dict(params)
+        wm = self.watermark(oid)
+        if wm:
+            q["min_version"] = wm
+        doc = self._request(
+            "GET",
+            f"/v1/ontologies/{oid}/query/{op}?" + urlencode(q),
+            None,
+            deadline_s,
+            # 412 = a lagging read replica behind this client's
+            # watermark: retryable — the fleet router falls back to
+            # the primary by itself; a direct replica catches up on
+            # the next publish
+            retry_statuses=RETRYABLE_STATUSES + (412,),
+        )
+        self._note_version(oid, doc)
+        return doc
+
+    def is_subsumed(
+        self, oid: str, sub: str, sup: str,
+        deadline_s: Optional[float] = None,
+    ) -> dict:
+        """O(words) subsumption test off the lock-free snapshot plane
+        (never queues behind classify traffic).  The response carries
+        the snapshot ``version`` it was answered from."""
+        return self._query_read(
+            oid, "subsumed", {"sub": sub, "sup": sup}, deadline_s
+        )
+
+    def query_subsumers(
+        self, oid: str, cls: str, deadline_s: Optional[float] = None
+    ) -> dict:
+        """A class's strict named subsumers off the snapshot plane
+        (same answer set as :meth:`subsumers`, without the scheduler
+        lane)."""
+        return self._query_read(
+            oid, "subsumers", {"class": cls}, deadline_s
+        )
+
+    def taxonomy_slice(
+        self, oid: str, cls: str, deadline_s: Optional[float] = None
+    ) -> dict:
+        """One class's taxonomy neighborhood (equivalents, subsumers,
+        subsumees, unsat flag) off the snapshot plane."""
+        return self._query_read(
+            oid, "slice", {"class": cls}, deadline_s
+        )
+
+    def snapshot_version(
+        self, oid: str, deadline_s: Optional[float] = None
+    ) -> dict:
+        return self._query_read(oid, "version", {}, deadline_s)
 
     def healthz(self) -> dict:
         return self._request("GET", "/healthz")

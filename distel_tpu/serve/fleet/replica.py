@@ -15,20 +15,34 @@ clients the same way the reference keeps Redis off the public net)::
                           register from a peer's handoff (restore from
                           the spill — byte-identical answers) or from
                           texts alone (journal-replay crash recovery)
+    POST /fleet/snapshot  {"id"}                        write the
+                          ontology's current READ snapshot to the
+                          shared spill dir (read-replica handoff
+                          artifact); returns {"id","version","path"}
+    POST /fleet/adopt_snapshot {"id","path"}            publish a peer's
+                          snapshot file into this replica's query store
+                          as a READ-ONLY copy (no registry entry, no
+                          write capability) — the router then fans
+                          reads for the ontology out here
 
-All three ride the scheduler's per-ontology lane, so a migrate-out
-serializes after every previously admitted request for that ontology —
-the spilled closure is exactly the state those requests produced, and
-nothing in flight is dropped.  ``/healthz`` additionally reports the
-replica id and the resident ontology ids (the router's placement
-recovery reads them after a respawn).
+Load/migrate/adopt ride the scheduler's per-ontology lane, so a
+migrate-out serializes after every previously admitted request for that
+ontology — the spilled closure is exactly the state those requests
+produced, and nothing in flight is dropped.  The two snapshot
+endpoints deliberately do NOT: they only touch the lock-free snapshot
+store (an immutable published view), so read replication never queues
+behind classify traffic.  ``/healthz`` additionally reports the replica
+id and the resident ontology ids (the router's placement recovery reads
+them after a respawn).
 """
 
 from __future__ import annotations
 
+import os
 import re
 from typing import List, Optional
 
+from distel_tpu.serve.query import OntologySnapshot, SnapshotMiss
 from distel_tpu.serve.server import HTTPError, ServeApp, _dumps, _json_doc
 
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
@@ -40,6 +54,10 @@ _FLEET_ROUTES = (
      "/fleet/migrate"),
     ("POST", re.compile(r"^/fleet/adopt/?$"), "fleet_adopt",
      "/fleet/adopt"),
+    ("POST", re.compile(r"^/fleet/snapshot/?$"), "fleet_snapshot",
+     "/fleet/snapshot"),
+    ("POST", re.compile(r"^/fleet/adopt_snapshot/?$"),
+     "fleet_adopt_snapshot", "/fleet/adopt_snapshot"),
 )
 
 
@@ -80,6 +98,8 @@ class ReplicaApp(ServeApp):
                     doc["texts"],
                     spill_path=doc.get("spill"),
                     warm=bool(doc.get("warm", True)),
+                    min_version=doc.get("version"),
+                    sha=doc.get("sha"),
                 )
             except ValueError as e:
                 if "already loaded" in str(e):
@@ -126,6 +146,84 @@ class ReplicaApp(ServeApp):
             raise HTTPError(400, 'body needs "texts": [str, ...]')
         rec = self._schedule(oid, "adopt", doc, deadline_s)
         return 200, "application/json", _dumps(rec)
+
+    # ---------------------------------------- read-replica snapshot wire
+
+    def _ep_fleet_snapshot(self, *, query, body, deadline_s):
+        """Export the ontology's CURRENT read snapshot to the shared
+        spill dir — the read-replication handoff.  Reads the lock-free
+        store only (no scheduler, no entry lock): an in-flight delta
+        simply means the file carries the previous version, which is
+        exactly the snapshot contract."""
+        doc = _json_doc(body)
+        oid = self._fleet_id(doc)
+        if self.query is None:
+            raise HTTPError(404, "query plane disabled (query.enable)")
+        if not self.registry.spill_dir:
+            raise HTTPError(
+                503, "snapshot export needs a spill_dir"
+            )
+        try:
+            snap = self.query.get(oid)
+        except SnapshotMiss:
+            raise HTTPError(404, f"no snapshot for {oid!r}")
+        path = os.path.join(
+            self.registry.spill_dir, f"{oid}.query.npz"
+        )
+        # write-then-rename: a concurrent replicate for the same oid
+        # (or a peer mid-np.load on the previous export) must never
+        # observe a torn file — os.replace swaps complete files.  The
+        # tmp name keeps the .npz suffix (savez appends it otherwise)
+        tmp = os.path.join(
+            self.registry.spill_dir,
+            f"{oid}.query.tmp{os.getpid()}.npz",
+        )
+        try:
+            nbytes = snap.save(tmp)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return 200, "application/json", _dumps(
+            {
+                "id": oid, "version": snap.version, "path": path,
+                "bytes": nbytes,
+            }
+        )
+
+    def _ep_fleet_adopt_snapshot(self, *, query, body, deadline_s):
+        """Publish a peer's exported snapshot file into this replica's
+        query store — a READ-ONLY copy (no registry entry: writes for
+        the ontology still 404 here and stay with the primary).  A
+        stale file (older than what this store already has) is refused
+        with 409 so the router never steps a read replica backwards."""
+        doc = _json_doc(body)
+        oid = self._fleet_id(doc)
+        path = doc.get("path")
+        if not isinstance(path, str) or not path:
+            raise HTTPError(400, 'body needs "path"')
+        if self.query is None:
+            raise HTTPError(404, "query plane disabled (query.enable)")
+        try:
+            snap = OntologySnapshot.load(
+                path, row_cache=self.config.query_row_cache
+            )
+        except (OSError, KeyError, ValueError) as e:
+            raise HTTPError(400, f"unreadable snapshot file: {e}")
+        if snap.oid != oid:
+            raise HTTPError(
+                400,
+                f"snapshot file is for {snap.oid!r}, not {oid!r}",
+            )
+        if not self.query.adopt(snap):
+            raise HTTPError(
+                409,
+                f"store already holds {oid!r} newer than version "
+                f"{snap.version}",
+            )
+        return 200, "application/json", _dumps(
+            {"id": oid, "version": snap.version, "read_only": True}
+        )
 
     def _ep_healthz(self, *, query, body, deadline_s):
         status, ctype, payload = super()._ep_healthz(

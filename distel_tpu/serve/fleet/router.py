@@ -72,10 +72,20 @@ _ROUTES = (
      "proxy", "/v1/ontologies/{id}/subsumers"),
     ("GET", re.compile(r"^/v1/ontologies/([^/]+)/taxonomy/?$"),
      "proxy", "/v1/ontologies/{id}/taxonomy"),
+    # snapshot reads fan out over the ontology's READ SET (primary +
+    # adopted read replicas) — writes keep strict affinity
+    ("GET",
+     re.compile(
+         r"^/v1/ontologies/([^/]+)/query/"
+         r"(subsumed|subsumers|slice|version)/?$"
+     ),
+     "read", "/v1/ontologies/{id}/query/*"),
     ("GET", re.compile(r"^/healthz/?$"), "healthz", "/healthz"),
     ("GET", re.compile(r"^/metrics/?$"), "metrics", "/metrics"),
     ("POST", re.compile(r"^/fleet/migrate/?$"), "migrate",
      "/fleet/migrate"),
+    ("POST", re.compile(r"^/fleet/replicate/?$"), "replicate",
+     "/fleet/replicate"),
     ("GET", re.compile(r"^/fleet/status/?$"), "status", "/fleet/status"),
     ("GET", re.compile(r"^/debug/trace/?$"), "debug_trace",
      "/debug/trace"),
@@ -149,6 +159,12 @@ class RouterApp:
         self._cv = threading.Condition()
         self._inflight: Dict[str, int] = {}
         self._migrating: set = set()
+        # read fan-out: oid → replica ids holding an adopted READ-ONLY
+        # snapshot (the primary is always implicitly in the read set);
+        # a plain round-robin tick spreads reads across the set
+        self._read_lock = threading.Lock()
+        self._read_placement: Dict[str, List[str]] = {}
+        self._read_rr: Dict[str, int] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         for name, help_text in (
@@ -164,6 +180,14 @@ class RouterApp:
              "ontologies re-placed by journal replay after an ejection"),
             ("distel_router_proxy_errors_total",
              "requests that failed against an unreachable replica"),
+            ("distel_router_reads_total",
+             "snapshot reads routed, by target (primary vs read "
+             "replica)"),
+            ("distel_router_read_fallbacks_total",
+             "fanned-out reads retried on the primary after a read "
+             "replica answered 404/412/5xx"),
+            ("distel_fleet_replications_total",
+             "read-snapshot replications driven to a peer replica"),
         ):
             self.metrics.describe(name, help_text)
         self.metrics.describe(
@@ -371,6 +395,134 @@ class RouterApp:
         finally:
             self._leave(oid)
 
+    # ---------------------------------------------------- read fan-out
+
+    def _read_set(self, oid: str, primary: ReplicaState
+                  ) -> List[ReplicaState]:
+        """Primary first, then every healthy read replica holding an
+        adopted snapshot for ``oid``."""
+        with self._read_lock:
+            rids = list(self._read_placement.get(oid, ()))
+        out = [primary]
+        for rid in rids:
+            try:
+                st = self.table.replica(rid)
+            except KeyError:
+                continue
+            if st.healthy and st.rid != primary.rid:
+                out.append(st)
+        return out
+
+    def _ep_read(self, oid, op, *, query, body, deadline_s, path):
+        """Fan a snapshot read out over the ontology's read set
+        (round-robin).  A read replica that answers 404 (no snapshot),
+        412 (lagging the caller's min_version watermark) or 5xx falls
+        back to the primary — the caller sees one monotonic read
+        stream, never the replica's lag.  Reads respect migration
+        holds (``_enter``), so zero reads fail across a handoff."""
+        from urllib.parse import quote
+
+        qs = "&".join(
+            f"{k}={quote(str(v))}" for k, v in query.items()
+        )
+        full = path + ("?" + qs if qs else "")
+        self._enter(oid)
+        try:
+            primary = self.table.lookup(oid)
+            if primary is None:
+                raise HTTPError(404, f"unknown ontology {oid!r}")
+            cands = self._read_set(oid, primary)
+            with self._read_lock:
+                tick = self._read_rr[oid] = (
+                    self._read_rr.get(oid, 0) + 1
+                )
+            target = cands[tick % len(cands)]
+            if target is not primary:
+                try:
+                    out = self._forward(
+                        target, "GET", full, None, deadline_s
+                    )
+                    self.metrics.counter_inc(
+                        "distel_router_reads_total",
+                        {"target": "replica"},
+                    )
+                    return out
+                except HTTPError as e:
+                    if e.status not in (404, 412, 502, 503):
+                        raise
+                    self.metrics.counter_inc(
+                        "distel_router_read_fallbacks_total"
+                    )
+            out = self._forward(primary, "GET", full, None, deadline_s)
+            self.metrics.counter_inc(
+                "distel_router_reads_total", {"target": "primary"}
+            )
+            return out
+        finally:
+            self._leave(oid)
+
+    def _ep_replicate(self, *, query, body, deadline_s, path):
+        doc = _json_doc(body)
+        oid = doc.get("id")
+        if not isinstance(oid, str) or not oid:
+            raise HTTPError(400, "body needs \"id\"")
+        rec = self.replicate(oid, dst_rid=doc.get("to"))
+        return 200, "application/json", _dumps(rec)
+
+    def replicate(self, oid: str, dst_rid: Optional[str] = None) -> dict:
+        """Copy the ontology's current read snapshot onto a peer
+        replica and add it to the read set — read QPS for the ontology
+        then scales past its primary's capacity while writes keep
+        strict affinity.  The copy is as-of NOW; later writes bump the
+        primary's version and the replica serves the older version
+        until the next replicate (lagging reads answer 412 against a
+        caller watermark and fall back to the primary above)."""
+        src = self.table.lookup(oid)
+        if src is None:
+            raise HTTPError(404, f"unknown ontology {oid!r}")
+        dst = self._pick_destination(src, dst_rid)
+        _, _, out = self._forward(
+            src, "POST", "/fleet/snapshot",
+            json.dumps({"id": oid}).encode("utf-8"), None,
+        )
+        rec = json.loads(out)
+        try:
+            self._forward(
+                dst, "POST", "/fleet/adopt_snapshot",
+                json.dumps(
+                    {"id": oid, "path": rec["path"]}
+                ).encode("utf-8"),
+                None,
+            )
+        except HTTPError as e:
+            if e.status != 409:
+                raise
+            # 409: the replica already holds this version or newer —
+            # committed either way, keep it in the read set
+        with self._read_lock:
+            rids = self._read_placement.setdefault(oid, [])
+            if dst.rid not in rids:
+                rids.append(dst.rid)
+        self.metrics.counter_inc("distel_fleet_replications_total")
+        self.flight.record(
+            "read_replicate", oid=oid, src=src.rid, dst=dst.rid,
+            version=rec.get("version"),
+        )
+        return {
+            "id": oid, "from": src.rid, "to": dst.rid,
+            "version": rec.get("version"),
+        }
+
+    def _prune_read_replica(self, rid: str) -> None:
+        """Drop a replica from every read set — its in-RAM snapshot
+        store died with the process (ejection/respawn)."""
+        with self._read_lock:
+            for oid, rids in list(self._read_placement.items()):
+                if rid in rids:
+                    rids.remove(rid)
+                if not rids:
+                    self._read_placement.pop(oid, None)
+
     def _ep_healthz(self, *, query, body, deadline_s, path):
         stats = self.table.stats()
         doc = {
@@ -552,6 +704,13 @@ class RouterApp:
                     "texts": handoff["texts"],
                     "spill": handoff["spill"],
                     "warm": True,
+                    # the source's last published snapshot version:
+                    # seeds the target's version floor so client read
+                    # watermarks survive the migration
+                    "version": handoff.get("version"),
+                    # in-band spill checksum: the adopting restore
+                    # verifies even if the .sha256 sidecar got lost
+                    "sha": handoff.get("sha"),
                 }
             ).encode("utf-8")
             t_adopt = time.monotonic()
@@ -729,6 +888,9 @@ class RouterApp:
         adopt re-classifies, and the heartbeat sweep must keep
         detecting OTHER replicas' failures meanwhile."""
         stranded = self.table.mark_ejected(st.rid)
+        # its snapshot store dies with the process: stop fanning reads
+        # at it (a respawned process comes back empty too)
+        self._prune_read_replica(st.rid)
         self.metrics.counter_inc("distel_fleet_ejections_total")
         self.flight.record(
             "eject", rid=st.rid, stranded=list(stranded),

@@ -1,15 +1,35 @@
-"""Warm-program ontology registry.
+"""Warm-program ontology registry over a tiered storage hierarchy.
 
 One :class:`~distel_tpu.core.incremental.IncrementalClassifier` per
 loaded ontology, kept *resident*: the compiled base program, the
 persistent normalizer/indexer caches, and the device-resident packed
 closure all survive across requests — the serving analog of the
-reference's always-up Redis stores (SURVEY.md §5).  Under a configurable
-memory budget the registry evicts least-recently-used ontologies by
-spilling their closure to disk (``runtime/checkpoint`` ``.npz`` wire
-form) and keeping the raw ontology texts; a later request transparently
-restores the classifier (frontend replay + warm-start rebuild,
-``IncrementalClassifier.restore``).
+reference's always-up Redis stores (SURVEY.md §5).  Under a
+configurable memory budget entries move down a three-tier hierarchy
+(the TPU-native answer to DistEL's L0 Redis-as-storage layer):
+
+* **hot** — resident classifier (today's behavior);
+* **warm** — host-RAM packed state only (``IncrementalClassifier.
+  demote``: engine, compiled-program refs, and device arrays dropped;
+  promoted back in milliseconds with NO frontend replay) — enabled by
+  ``warm_budget_bytes`` > 0;
+* **cold** — compressed ``.npz`` disk spill with an integrity
+  checksum sidecar; restore replays the texts through the frontend
+  (``IncrementalClassifier.restore``) and verifies the checksum.
+
+Victim selection and prefetch are traffic-driven: a per-ontology
+read/write EWMA (``serve/storage/tiers.TierTraffic``) cools the
+quietest entry first and promotes the read-hottest non-resident entry
+when budget headroom opens.
+
+On every commit (load, applied delta, adopt, restore) the registry
+additionally publishes an immutable versioned read snapshot into the
+attached :class:`~distel_tpu.serve.query.SnapshotStore` (swap-on-
+commit, under the entry lock so a publish can never interleave with an
+export) — the query plane serves reads off it without ever touching
+the scheduler lane or the entry lock.  Eviction demotes only the
+WRITE-side state: the published snapshot stays readable while the
+entry is warm or cold.
 
 Concurrency contract: the scheduler serializes requests *per ontology*,
 so an entry's classifier is only ever driven by one worker at a time;
@@ -20,6 +40,7 @@ never spilled mid-request).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
@@ -27,25 +48,51 @@ from typing import Dict, List, Optional
 
 from distel_tpu.config import ClassifierConfig
 from distel_tpu.obs import trace as obs_trace
+from distel_tpu.serve.storage.tiers import TierTraffic
 
 
 class UnknownOntology(KeyError):
     """No ontology registered under this id."""
 
 
+class ColdSpillCorrupted(RuntimeError):
+    """A cold spill failed its integrity checksum — the on-disk bytes
+    are not the ones the registry wrote (bit rot, torn write, wrong
+    file).  Restoring it would warm-start saturation from garbage and
+    monotone EL+ would keep every wrong bit, so the restore refuses
+    loudly instead."""
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 class _Entry:
     __slots__ = (
-        "oid", "inc", "texts", "resident_bytes", "last_used",
-        "spill_path", "lock",
+        "oid", "inc", "warm_inc", "texts", "resident_bytes",
+        "warm_bytes", "cold_bytes", "hot_bytes_estimate", "last_used",
+        "spill_path", "spill_sha", "lock",
     )
 
     def __init__(self, oid: str):
         self.oid = oid
-        self.inc = None  # IncrementalClassifier when resident
+        self.inc = None  # IncrementalClassifier when hot (resident)
+        self.warm_inc = None  # demoted classifier when warm
         self.texts: List[str] = []
         self.resident_bytes = 0
+        self.warm_bytes = 0
+        self.cold_bytes = 0
+        #: resident footprint the entry had when it was last hot — the
+        #: promotion cost estimate (cold_bytes is COMPRESSED, often
+        #: 100x+ smaller than what a restore re-materializes)
+        self.hot_bytes_estimate = 0
         self.last_used = time.monotonic()
         self.spill_path: Optional[str] = None
+        self.spill_sha: Optional[str] = None
         self.lock = threading.RLock()
 
 
@@ -71,9 +118,27 @@ class OntologyRegistry:
         metrics=None,
         fast_path_min_concepts: Optional[int] = None,
         flight=None,
+        warm_budget_bytes: Optional[int] = None,
+        query=None,
     ):
         self.config = config or ClassifierConfig()
         self.memory_budget_bytes = memory_budget_bytes
+        #: host-RAM warm-tier byte budget (0 = warm tier off: hot
+        #: evictions spill straight to cold, the pre-tiering behavior);
+        #: None falls back to the ``storage.warm.budget.mb`` knob
+        if warm_budget_bytes is None:
+            warm_budget_bytes = int(
+                self.config.storage_warm_budget_mb * (1 << 20)
+            )
+        self.warm_budget_bytes = warm_budget_bytes
+        #: optional :class:`~distel_tpu.serve.query.SnapshotStore` —
+        #: when attached, every commit publishes a versioned read
+        #: snapshot into it (the lock-free query plane)
+        self.query = query
+        #: per-ontology read/write EWMA driving victim selection and
+        #: prefetch (leaf structure; only ever called lock-free or
+        #: outside the registry/entry locks)
+        self.traffic = TierTraffic(self.config.storage_ewma_halflife_s)
         self.spill_dir = spill_dir
         self.metrics = metrics
         #: optional :class:`~distel_tpu.obs.FlightRecorder` — the
@@ -149,13 +214,41 @@ class OntologyRegistry:
         with self._lock:
             entries = list(self._entries.values())
         resident = [e for e in entries if e.inc is not None]
+        warm = [e for e in entries if e.inc is None and e.warm_inc]
         return {
             "ontologies": len(entries),
             "resident": len(resident),
+            "warm": len(warm),
             "spilled": len(entries) - len(resident),
             "resident_bytes": sum(e.resident_bytes for e in resident),
             "memory_budget_bytes": self.memory_budget_bytes,
         }
+
+    def tier_stats(self) -> dict:
+        """Per-tier byte/count accounting — the ``distel_tier_*``
+        gauge families on ``/metrics`` render from one call, so bytes
+        and counts stay mutually consistent within a scrape."""
+        with self._lock:
+            entries = list(self._entries.values())
+        resident = [e for e in entries if e.inc is not None]
+        warm = [e for e in entries if e.inc is None and e.warm_inc]
+        cold = [
+            e for e in entries
+            if e.inc is None and e.warm_inc is None and e.spill_path
+        ]
+        return {
+            "resident_bytes": sum(e.resident_bytes for e in resident),
+            "warm_bytes": sum(e.warm_bytes for e in warm),
+            "cold_bytes": sum(e.cold_bytes for e in cold),
+            "resident_ontologies": len(resident),
+            "warm_ontologies": len(warm),
+            "cold_ontologies": len(cold),
+        }
+
+    def note_read(self, oid: str) -> None:
+        """Query-plane read hook: feeds the traffic EWMA that decides
+        tier promotion — called lock-free off the read path."""
+        self.traffic.note_read(oid)
 
     def resident_bytes(self) -> int:
         with self._lock:
@@ -179,12 +272,14 @@ class OntologyRegistry:
                 entry.texts.append(text)
                 entry.resident_bytes = _state_bytes(inc)
                 entry.last_used = time.monotonic()
+                version = self._publish(oid, inc)
         except BaseException:
             # a failed load must not leave a zombie id behind (listed by
             # /healthz, un-restorable, growing the map on every retry)
             with self._lock:
                 self._entries.pop(oid, None)
             raise
+        self.traffic.note_write(oid)
         self._note_path(inc)
         self._maybe_evict(keep=oid)
         rec = dict(inc.history[-1])
@@ -194,6 +289,8 @@ class OntologyRegistry:
             links=result.idx.n_links,
             roles=result.idx.n_roles,
         )
+        if version is not None:
+            rec["version"] = version
         return rec
 
     def delta(self, oid: str, texts: List[str]) -> dict:
@@ -222,10 +319,14 @@ class OntologyRegistry:
             result = inc.add_ontology(onto)
             entry.resident_bytes = _state_bytes(inc)
             entry.last_used = time.monotonic()
+            version = self._publish(oid, inc)
+        self.traffic.note_write(oid)
         self._note_path(inc)
         self._maybe_evict(keep=oid)
         rec = dict(inc.history[-1])
         rec.update(id=oid, batched=len(texts), concepts=result.idx.n_concepts)
+        if version is not None:
+            rec["version"] = version
         return rec
 
     def classifier(self, oid: str):
@@ -260,13 +361,29 @@ class OntologyRegistry:
             # while the router rebalances the same oid) must not both
             # return a handoff — the loser sees UnknownOntology
             self._check_live(entry)
+            version = None
+            if self.query is not None:
+                # unpublish BEFORE deregistering (still under the entry
+                # lock, so no in-flight commit can republish): reads for
+                # a migrated-out ontology must 404 so the router
+                # re-routes to the adopting replica
+                try:
+                    version = self.query.get(oid).version
+                except KeyError:
+                    pass
+                self.query.drop(oid)
             path = self._spill(entry)
             texts = list(entry.texts)
+            sha = entry.spill_sha
             with self._lock:
                 self._entries.pop(oid, None)
+        self.traffic.forget(oid)
         self._count("distel_registry_exports_total")
         self._event("registry_export", oid=oid, spill=path)
-        return {"id": oid, "texts": texts, "spill": path}
+        return {
+            "id": oid, "texts": texts, "spill": path, "sha": sha,
+            "version": version,
+        }
 
     def adopt(
         self,
@@ -274,6 +391,8 @@ class OntologyRegistry:
         texts: List[str],
         spill_path: Optional[str] = None,
         warm: bool = True,
+        min_version: Optional[int] = None,
+        sha: Optional[str] = None,
     ) -> dict:
         """Migrate-in hook: register an ontology from a peer's
         :meth:`export` record.  With a ``spill_path`` the closure
@@ -284,9 +403,21 @@ class OntologyRegistry:
 
         ``warm=True`` restores eagerly so the handoff completes with a
         resident classifier; ``warm=False`` defers to the first request
-        (the LRU lazy-restore path)."""
+        (the LRU lazy-restore path).
+
+        ``min_version``: the source replica's last published snapshot
+        version (the export record carries it) — seeds the query
+        store's version floor so the adopted copy's snapshots continue
+        the source's sequence and client read watermarks survive the
+        migration.
+
+        ``sha``: the export's in-band spill checksum — verification
+        then doesn't depend on the ``.sha256`` sidecar having survived
+        the shared spill dir."""
         if not texts:
             raise ValueError("adopt needs at least one ontology text")
+        if min_version and self.query is not None:
+            self.query.seed_version(oid, int(min_version))
         with self._lock:
             if oid in self._entries:
                 raise ValueError(f"ontology id already loaded: {oid}")
@@ -296,6 +427,7 @@ class OntologyRegistry:
                 if spill_path is not None:
                     entry.texts = list(texts)
                     entry.spill_path = spill_path
+                    entry.spill_sha = sha
                     if warm:
                         self._resident(entry)
                 else:
@@ -304,6 +436,7 @@ class OntologyRegistry:
                     entry.inc = inc
                     entry.texts = list(texts)
                     entry.resident_bytes = _state_bytes(inc)
+                    self._publish(oid, inc)
                 entry.last_used = time.monotonic()
         except BaseException:
             # a failed adopt must not leave a zombie id behind
@@ -326,14 +459,73 @@ class OntologyRegistry:
 
     # ------------------------------------------------------ spill plane
 
+    def _publish(self, oid: str, inc) -> Optional[int]:
+        """Publish the committed closure as a versioned read snapshot
+        (swap-on-commit).  Caller holds ``entry.lock`` — a publish must
+        never interleave with an export's unpublish-and-deregister."""
+        if self.query is None or inc.last_result is None:
+            return None
+        snap = self.query.publish_result(
+            oid, inc.last_result, at_least=inc.increment
+        )
+        return snap.version
+
+    def _publish_if_missing(self, oid: str, inc) -> Optional[int]:
+        """Restore/promote paths re-publish only when no snapshot is
+        live OR the live one is behind this classifier's increment:
+        eviction never unpublished (reads keep working while the
+        write-side state is warm/cold), but a replica that adopts an
+        ontology it previously held only a READ-ONLY copy of must
+        supersede that older copy, or its reads would serve the stale
+        version forever.  Caller holds ``entry.lock``."""
+        if self.query is None:
+            return None
+        try:
+            snap = self.query.get(oid)
+            if snap.increment >= inc.increment:
+                return snap.version
+        except KeyError:
+            pass
+        return self._publish(oid, inc)
+
     def _resident(self, entry: _Entry):
-        """Entry's classifier, restoring from the spill file when the
-        entry was evicted.  Caller holds ``entry.lock``."""
+        """Entry's classifier, promoted from the warm tier (host-RAM
+        packed state, no frontend replay) or restored from the cold
+        spill (checksum-verified, full text replay).  Caller holds
+        ``entry.lock``."""
         if entry.inc is not None:
             return entry.inc
+        t0 = time.monotonic()
+        if entry.warm_inc is not None:
+            # warm → hot: re-embed the retained host state under a
+            # fresh (normally registry-cached) engine — one quiet
+            # saturation pass, no parse/normalize/index
+            with obs_trace.child_span(
+                "registry.promote", {"oid": entry.oid}
+            ):
+                inc = entry.warm_inc
+                entry.warm_inc = None
+                inc.promote()
+            entry.inc = inc
+            entry.resident_bytes = _state_bytes(inc)
+            entry.warm_bytes = 0
+            wall = time.monotonic() - t0
+            self._count("distel_tier_promotions_total", tier="warm")
+            self._event(
+                "tier_promote", oid=entry.oid, tier="warm",
+                wall_s=round(wall, 4),
+            )
+            if self.metrics is not None:
+                self.metrics.observe(
+                    "distel_registry_promote_seconds", wall
+                )
+            self._note_compile(inc.last_compile)
+            self._publish_if_missing(entry.oid, inc)
+            self._maybe_evict(keep=entry.oid)
+            return inc
         from distel_tpu.core.incremental import IncrementalClassifier
 
-        t0 = time.monotonic()
+        self._verify_spill(entry)
         with obs_trace.child_span(
             "registry.restore", {"oid": entry.oid}
         ):
@@ -345,6 +537,7 @@ class OntologyRegistry:
         entry.inc = inc
         entry.resident_bytes = _state_bytes(inc)
         self._count("distel_registry_restores_total")
+        self._count("distel_tier_promotions_total", tier="cold")
         self._event(
             "registry_restore",
             oid=entry.oid,
@@ -358,32 +551,141 @@ class OntologyRegistry:
         # a warm-bucket restore shows up here as a program-cache hit
         # with compile ≈ 0 (the whole point of the warmup precompile)
         self._note_compile(inc.last_compile)
+        self._publish_if_missing(entry.oid, inc)
         self._maybe_evict(keep=entry.oid)
         return inc
+
+    def _verify_spill(self, entry: _Entry) -> None:
+        """Integrity-check a cold spill against its checksum before
+        restoring from it.  The expected digest comes from the entry
+        (same-process respill) or the ``.sha256`` sidecar the spill
+        writer left (cross-process adopt over the shared spill dir);
+        spills from before the checksum era have neither and restore
+        unverified (back-compat)."""
+        if not entry.spill_path:
+            return
+        expected = entry.spill_sha
+        if expected is None:
+            sidecar = entry.spill_path + ".sha256"
+            if os.path.exists(sidecar):
+                with open(sidecar) as f:
+                    expected = f.read().strip() or None
+        if expected is None:
+            return
+        actual = _file_sha256(entry.spill_path)
+        if actual != expected:
+            self._event(
+                "spill_corrupt", oid=entry.oid,
+                spill=entry.spill_path,
+                expected=expected[:16], actual=actual[:16],
+            )
+            raise ColdSpillCorrupted(
+                f"cold spill {entry.spill_path!r} of {entry.oid!r} "
+                f"failed its checksum (expected {expected[:16]}…, got "
+                f"{actual[:16]}…) — refusing to warm-start from "
+                "corrupted state"
+            )
 
     def _spill_path(self, oid: str) -> str:
         return os.path.join(self.spill_dir, f"{oid}.snapshot.npz")
 
+    def _warm_result(self, inc):
+        """A :class:`SaturationResult`-shaped view over a DEMOTED
+        classifier's host state, so a warm entry can spill to cold
+        without promoting first.  Iteration/derivation counters are
+        informational in the snapshot meta and not retained by the
+        warm tier — restore re-derives its own."""
+        import numpy as np
+
+        from distel_tpu.core.engine import SaturationResult
+
+        s, r = inc._state
+        transposed = s.dtype == np.uint32
+        return SaturationResult(
+            packed_s=s,
+            packed_r=r,
+            iterations=0,
+            derivations=0,
+            idx=inc._warm_idx,
+            transposed=transposed,
+            _s=None if transposed else s,
+            _r=None if transposed else r,
+        )
+
     def _spill(self, entry: _Entry) -> Optional[str]:
-        """Snapshot the entry's closure and drop the classifier.  Caller
-        holds ``entry.lock``."""
-        if entry.inc is None:
+        """Demote the entry to the COLD tier: snapshot the closure
+        (hot classifier or warm host state) to disk — compressed per
+        ``storage.compress.spills`` — with a ``.sha256`` integrity
+        sidecar, and drop every in-RAM copy.  Caller holds
+        ``entry.lock``."""
+        if entry.inc is None and entry.warm_inc is None:
             return entry.spill_path
         path = self._spill_path(entry.oid)
-        # uncompressed: eviction sits on the request path, and zlib on a
-        # multi-GB closure costs minutes (same call as scale_probe's
-        # mid-run snapshots)
-        entry.inc.snapshot(path, compressed=False)
+        compressed = bool(self.config.storage_compress_spills)
+        t0 = time.monotonic()
+        if entry.inc is not None:
+            entry.inc.snapshot(path, compressed=compressed)
+        else:
+            from distel_tpu.runtime.checkpoint import save_snapshot
+
+            save_snapshot(
+                path, self._warm_result(entry.warm_inc),
+                compressed=compressed,
+            )
+        sha = _file_sha256(path)
+        with open(path + ".sha256", "w") as f:
+            f.write(sha + "\n")
         entry.spill_path = path
+        entry.spill_sha = sha
+        entry.cold_bytes = os.path.getsize(path)
+        if entry.resident_bytes or entry.warm_bytes:
+            entry.hot_bytes_estimate = (
+                entry.resident_bytes or entry.warm_bytes
+            )
         entry.inc = None
+        entry.warm_inc = None
         entry.resident_bytes = 0
+        entry.warm_bytes = 0
+        # the satellite contract: written bytes + compression wall land
+        # in the registry_spill event (zlib on a multi-GB closure is
+        # minutes of single-core wall — the record must say who paid)
+        self._event(
+            "registry_spill",
+            oid=entry.oid,
+            spill=path,
+            bytes=entry.cold_bytes,
+            compressed=compressed,
+            wall_s=round(time.monotonic() - t0, 4),
+        )
         return path
 
+    def _demote_warm(self, entry: _Entry) -> None:
+        """Demote a hot entry to the WARM tier (host-RAM packed state,
+        engine/programs/device arrays dropped).  Caller holds
+        ``entry.lock``."""
+        t0 = time.monotonic()
+        inc = entry.inc
+        entry.hot_bytes_estimate = entry.resident_bytes
+        entry.warm_bytes = inc.demote()
+        entry.warm_inc = inc
+        entry.inc = None
+        entry.resident_bytes = 0
+        self._count("distel_tier_demotions_total", tier="warm")
+        self._event(
+            "tier_demote", oid=entry.oid, tier="warm",
+            bytes=entry.warm_bytes,
+            wall_s=round(time.monotonic() - t0, 4),
+        )
+
     def _maybe_evict(self, keep: Optional[str] = None) -> None:
-        """Spill LRU entries until the resident closures fit the budget.
-        Never evicts ``keep`` (the entry just touched) and never blocks
-        on a busy entry's lock — a concurrent request beats a byte
-        target."""
+        """Demote entries down the tier ladder until each tier fits its
+        budget: hot overflow cools to WARM (host-RAM packed state) when
+        a warm budget is configured — else straight to COLD — and warm
+        overflow spills to COLD.  The victim is the lowest-traffic
+        entry by the read/write EWMA (``last_used`` breaks ties, the
+        old LRU order).  Never evicts ``keep`` (the entry just
+        touched) and never blocks on a busy entry's lock — a
+        concurrent request beats a byte target."""
         if self.memory_budget_bytes is None:
             return
         while True:
@@ -401,24 +703,118 @@ class OntologyRegistry:
                     if e.inc is not None and e.oid != keep
                 ]
                 if total <= self.memory_budget_bytes or not victims:
-                    return
-                victim = min(victims, key=lambda e: e.last_used)
+                    break
+            victim = self._pick_victim(victims)
             if not victim.lock.acquire(blocking=False):
                 return  # busy: let the in-flight request finish first
             try:
                 if victim.inc is None:
                     continue  # raced with another evictor
                 bytes_freed = victim.resident_bytes
-                self._spill(victim)
+                if self.warm_budget_bytes > 0:
+                    self._demote_warm(victim)
+                else:
+                    self._spill(victim)
                 self._count("distel_registry_evictions_total")
                 self._event(
                     "registry_evict",
                     oid=victim.oid,
                     bytes=bytes_freed,
+                    to="warm" if victim.warm_inc is not None else "cold",
                     spill=victim.spill_path,
                 )
             finally:
                 victim.lock.release()
+        self._shed_warm(keep)
+
+    def _pick_victim(self, victims: List[_Entry]) -> _Entry:
+        """Lowest-traffic entry (EWMA scored OUTSIDE the registry
+        lock — TierTraffic has its own leaf lock), last_used tiebreak."""
+        scores = {e.oid: self.traffic.score(e.oid) for e in victims}
+        return min(victims, key=lambda e: (scores[e.oid], e.last_used))
+
+    def _shed_warm(self, keep: Optional[str] = None) -> None:
+        """Spill warm-tier overflow to cold until the warm budget
+        fits."""
+        if self.warm_budget_bytes <= 0:
+            return
+        while True:
+            with self._lock:
+                warm = [
+                    e
+                    for e in self._entries.values()
+                    if e.inc is None and e.warm_inc is not None
+                ]
+                total = sum(e.warm_bytes for e in warm)
+                victims = [e for e in warm if e.oid != keep]
+                if total <= self.warm_budget_bytes or not victims:
+                    return
+            victim = self._pick_victim(victims)
+            if not victim.lock.acquire(blocking=False):
+                return
+            try:
+                if victim.warm_inc is None:
+                    continue  # raced: promoted or already spilled
+                self._spill(victim)
+                self._count(
+                    "distel_tier_demotions_total", tier="cold"
+                )
+            finally:
+                victim.lock.release()
+
+    def maybe_prefetch(self) -> Optional[str]:
+        """Traffic-driven promotion: bring the READ-hottest non-hot
+        entry back to the hot set while byte headroom exists (warm
+        entries promote in milliseconds; cold ones pay the full
+        restore).  Called by the serve plane's background promoter
+        thread and directly by tests.  Returns the promoted oid, or
+        None when there is no headroom, no candidate, or the candidate
+        is busy."""
+        if self.memory_budget_bytes is None:
+            return None
+        with self._lock:
+            hot_total = sum(
+                e.resident_bytes
+                for e in self._entries.values()
+                if e.inc is not None
+            )
+            # promotion cost = what the entry RESIDENTLY weighed when
+            # last hot (warm bytes track it closely; cold_bytes are
+            # compressed — often 100x+ smaller than the restore would
+            # re-materialize, so they must never size the decision).
+            # An entry adopted cold into a fresh process has no
+            # estimate yet and is skipped: its first demanded request
+            # promotes it organically and records one.
+            candidates = {
+                e.oid: (e.hot_bytes_estimate or e.warm_bytes)
+                for e in self._entries.values()
+                if e.inc is None and (e.warm_inc or e.spill_path)
+            }
+        headroom = self.memory_budget_bytes - hot_total
+        if headroom <= 0:
+            return None
+        candidates = {o: b for o, b in candidates.items() if b > 0}
+        if not candidates:
+            return None
+        oid = self.traffic.hottest(candidates)
+        if oid is None or candidates[oid] > headroom:
+            return None
+        entry = self._entries.get(oid)
+        if entry is None:
+            return None
+        if not entry.lock.acquire(blocking=False):
+            return None
+        try:
+            self._check_live(entry)
+            if entry.inc is not None:
+                return None  # promoted by a request meanwhile
+            self._resident(entry)
+            self._event("tier_prefetch", oid=oid)
+            return oid
+        except UnknownOntology:
+            return None
+        finally:
+            entry.lock.release()
 
     def spill_all(self) -> List[str]:
         """Graceful-shutdown hook: snapshot every resident ontology so a
@@ -431,7 +827,7 @@ class OntologyRegistry:
         paths = []
         for entry in entries:
             with entry.lock:
-                if entry.inc is None:
+                if entry.inc is None and entry.warm_inc is None:
                     continue
                 paths.append(self._spill(entry))
                 self._count("distel_registry_shutdown_spills_total")

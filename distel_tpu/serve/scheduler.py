@@ -248,9 +248,14 @@ class RequestScheduler:
                 {"kind": kind},
                 buckets=(1, 2, 4, 8, 16, 32, 64),
             )
+            # labeled by kind: the serving dashboards split lane-read
+            # wait (legacy subsumers/taxonomy queries stuck behind a
+            # delta) from write wait — the gap the snapshot-plane
+            # /query endpoints exist to close
             self.metrics.observe(
                 "distel_queue_wait_seconds",
                 now - min(r.enqueued for r in live),
+                {"kind": kind},
             )
         # traced requests: the time spent queued becomes a span per
         # request, and the execution wraps in a lane-exec span ACTIVATED

@@ -6,6 +6,11 @@ Endpoints::
     POST /v1/ontologies/{id}/deltas        incremental update (fast path)
     GET  /v1/ontologies/{id}/subsumers     ?class=<name> — named subsumers
     GET  /v1/ontologies/{id}/taxonomy      parents/equivalents/unsat
+    GET  /v1/ontologies/{id}/query/subsumed    ?sub=&sup= — O(words) bit
+                                           test off the read snapshot
+    GET  /v1/ontologies/{id}/query/subsumers   ?class= — snapshot subsumers
+    GET  /v1/ontologies/{id}/query/slice       ?class= — taxonomy slice
+    GET  /v1/ontologies/{id}/query/version     current snapshot version
     GET  /healthz                          liveness + registry stats
     GET  /metrics                          Prometheus text format
 
@@ -13,8 +18,14 @@ Request bodies are JSON ``{"text": "<OWL functional syntax>"}``.  Write
 requests ride the scheduler (per-ontology serialization, delta batching,
 admission control); an over-capacity queue answers 429 + Retry-After and
 an over-deadline request answers 503 while the worker recovers on its
-own.  SIGTERM/SIGINT drain the scheduler and spill every resident
-closure through the checkpoint machinery before exit.
+own.  The ``/query/*`` READ endpoints never touch the scheduler or the
+registry's entry locks: they answer straight off the ontology's current
+immutable snapshot (published swap-on-commit by the registry), carry
+the snapshot ``version`` in every response, and honor a
+``min_version=`` precondition with 412 (the monotonic-reads guard a
+router falls back to the primary on).  SIGTERM/SIGINT drain the
+scheduler and spill every resident closure through the checkpoint
+machinery before exit.
 """
 
 from __future__ import annotations
@@ -35,6 +46,11 @@ from distel_tpu.obs.flight import FlightRecorder
 from distel_tpu.obs.trace import SpanRecorder, TraceContext, chrome_trace
 from distel_tpu.runtime.instrumentation import PhaseAggregate, PhaseTimer
 from distel_tpu.serve.metrics import Metrics
+from distel_tpu.serve.query import (
+    SnapshotMiss,
+    SnapshotStore,
+    StaleSnapshot,
+)
 from distel_tpu.serve.registry import OntologyRegistry, UnknownOntology
 from distel_tpu.serve.scheduler import (
     Deadline,
@@ -61,6 +77,16 @@ _ROUTES = (
      "subsumers", "/v1/ontologies/{id}/subsumers"),
     ("GET", re.compile(r"^/v1/ontologies/([^/]+)/taxonomy/?$"),
      "taxonomy", "/v1/ontologies/{id}/taxonomy"),
+    # lock-free read plane: answered off the versioned snapshot, never
+    # scheduled (one canonical metrics label per op)
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/query/subsumed/?$"),
+     "q_subsumed", "/v1/ontologies/{id}/query/subsumed"),
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/query/subsumers/?$"),
+     "q_subsumers", "/v1/ontologies/{id}/query/subsumers"),
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/query/slice/?$"),
+     "q_slice", "/v1/ontologies/{id}/query/slice"),
+    ("GET", re.compile(r"^/v1/ontologies/([^/]+)/query/version/?$"),
+     "q_version", "/v1/ontologies/{id}/query/version"),
     ("GET", re.compile(r"^/healthz/?$"), "healthz", "/healthz"),
     ("GET", re.compile(r"^/metrics/?$"), "metrics", "/metrics"),
     ("GET", re.compile(r"^/debug/trace/?$"), "debug_trace",
@@ -177,6 +203,7 @@ class ServeApp:
         spill_dir: Optional[str] = None,
         fast_path_min_concepts: Optional[int] = None,
         warmup_paths: Optional[List[str]] = None,
+        warm_budget_bytes: Optional[int] = None,
     ):
         self.config = config or ClassifierConfig()
         self.default_deadline_s = deadline_s
@@ -191,6 +218,18 @@ class ServeApp:
         self.flight = FlightRecorder(
             capacity=self.config.obs_flight_capacity, service="serve"
         )
+        # ---- read plane: the per-ontology versioned snapshot store
+        # the /query/* endpoints answer from (None = knob off: the
+        # endpoints 404 and commits build no host snapshot)
+        self.query = (
+            SnapshotStore(
+                row_cache=self.config.query_row_cache,
+                metrics=self.metrics,
+                flight=self.flight,
+            )
+            if self.config.query_enable
+            else None
+        )
         self.registry = OntologyRegistry(
             self.config,
             memory_budget_bytes=memory_budget_bytes,
@@ -198,6 +237,8 @@ class ServeApp:
             metrics=self.metrics,
             fast_path_min_concepts=fast_path_min_concepts,
             flight=self.flight,
+            warm_budget_bytes=warm_budget_bytes,
+            query=self.query,
         )
         self.scheduler = RequestScheduler(
             self._execute,
@@ -261,6 +302,70 @@ class ServeApp:
             "distel_warmup_programs_total",
             "bucket programs precompiled by the startup warmup",
         )
+        # ---- read plane (query snapshots) + storage-tier accounting
+        self.metrics.describe(
+            "distel_read_seconds",
+            "snapshot-plane read latency by op (never rides the "
+            "scheduler lane)",
+        )
+        self.metrics.describe(
+            "distel_read_stale_total",
+            "reads refused with 412 because the snapshot was older "
+            "than the caller's min_version watermark",
+        )
+        self.metrics.describe(
+            "distel_query_publish_seconds",
+            "per-commit snapshot build+swap wall",
+        )
+        self.metrics.describe(
+            "distel_registry_promote_seconds",
+            "warm-to-hot promotion wall (no frontend replay)",
+        )
+        self.metrics.describe(
+            "distel_tier_promotions_total",
+            "entries promoted toward hot, by source tier",
+        )
+        self.metrics.describe(
+            "distel_tier_demotions_total",
+            "entries demoted down the hierarchy, by target tier",
+        )
+        _TIER_GAUGES = (
+            ("distel_tier_resident_bytes", "resident_bytes",
+             "hot-tier packed-closure bytes (device/host resident)"),
+            ("distel_tier_warm_bytes", "warm_bytes",
+             "warm-tier host-RAM packed snapshot bytes"),
+            ("distel_tier_cold_bytes", "cold_bytes",
+             "cold-tier compressed spill bytes on disk"),
+            ("distel_tier_resident_ontologies", "resident_ontologies",
+             "ontologies in the hot tier"),
+            ("distel_tier_warm_ontologies", "warm_ontologies",
+             "ontologies in the warm tier"),
+            ("distel_tier_cold_ontologies", "cold_ontologies",
+             "ontologies in the cold tier"),
+        )
+
+        def _tier_gauges():
+            snap = self.registry.tier_stats()
+            return {m: snap[k] for m, k, _ in _TIER_GAUGES}
+
+        for metric, _, help_text in _TIER_GAUGES:
+            self.metrics.describe(metric, help_text)
+        self.metrics.gauge_group(_tier_gauges)
+        if self.query is not None:
+            _QUERY_GAUGES = (
+                ("distel_query_snapshots", "snapshots",
+                 "ontologies with a published read snapshot"),
+                ("distel_query_snapshot_bytes", "snapshot_bytes",
+                 "host bytes held by published read snapshots"),
+            )
+
+            def _query_gauges():
+                snap = self.query.stats()
+                return {m: snap[k] for m, k, _ in _QUERY_GAUGES}
+
+            for metric, _, help_text in _QUERY_GAUGES:
+                self.metrics.describe(metric, help_text)
+            self.metrics.gauge_group(_query_gauges)
         # ---- adaptive sparse-tail frontier telemetry: live-sampled
         # from the process-global controller aggregate
         # (runtime/instrumentation.FRONTIER_EVENTS) — per-round tier
@@ -339,6 +444,23 @@ class ServeApp:
         # registry / persistent cache for the configured buckets BEFORE
         # traffic arrives; a failure only leaves the caches cold (the
         # error counter says so), it never blocks serving
+        # ---- background tier promoter: traffic-driven prefetch of
+        # warm/cold entries back toward hot while budget headroom
+        # exists (the registry's EWMA picks the read-hottest victim);
+        # only meaningful under a memory budget
+        self._stop_promoter = threading.Event()
+        self._promoter: Optional[threading.Thread] = None
+        if (
+            memory_budget_bytes is not None
+            and self.config.storage_prefetch_interval_s > 0
+        ):
+            self._promoter = threading.Thread(
+                target=self._promote_loop,
+                args=(self.config.storage_prefetch_interval_s,),
+                daemon=True,
+                name="distel-tier-promoter",
+            )
+            self._promoter.start()
         self._warmup_done = threading.Event()
         if warmup_paths:
             self.metrics.gauge_set("distel_warmup_done", 0)
@@ -350,6 +472,13 @@ class ServeApp:
             ).start()
         else:
             self._warmup_done.set()
+
+    def _promote_loop(self, interval_s: float) -> None:
+        while not self._stop_promoter.wait(interval_s):
+            try:
+                self.registry.maybe_prefetch()
+            except Exception:
+                continue  # the promoter must outlive any one bad entry
 
     def _run_warmup(self, paths: List[str]) -> None:
         try:
@@ -503,6 +632,102 @@ class ServeApp:
         rec = self._schedule(oid, "taxonomy", None, deadline_s)
         return 200, "application/json", _dumps(rec)
 
+    # ---------------------------------------------- lock-free read plane
+
+    def _snapshot_for(self, oid: str, query: dict):
+        """The ontology's current snapshot, honoring ``min_version``.
+        Raises the read plane's canonical statuses: 404 (unknown id or
+        query plane off), 503 + Retry-After (known id, snapshot not
+        published yet — a commit is in flight), 412 (snapshot older
+        than the caller's watermark — the router falls back to the
+        primary)."""
+        if self.query is None:
+            raise HTTPError(404, "query plane disabled (query.enable)")
+        raw = query.get("min_version")
+        try:
+            min_version = int(raw) if raw else None
+        except ValueError:
+            raise HTTPError(400, "invalid min_version")
+        try:
+            return self.query.get(oid, min_version=min_version)
+        except StaleSnapshot as e:
+            self.metrics.counter_inc("distel_read_stale_total")
+            raise HTTPError(
+                412,
+                str(e),
+                {"Retry-After": "1", "X-Distel-Version": str(e.version)},
+            )
+        except SnapshotMiss:
+            if oid in self.registry.ids():
+                raise HTTPError(
+                    503,
+                    f"no snapshot published for {oid!r} yet",
+                    {"Retry-After": "1"},
+                )
+            raise HTTPError(404, f"unknown ontology {oid!r}")
+
+    def _read(self, oid: str, op: str, query: dict, answer) -> tuple:
+        """One snapshot read: resolve the snapshot, run ``answer(snap)``
+        (KeyError → 404 unknown class), stamp the version, record
+        latency + the registry's read-traffic EWMA.  Never touches the
+        scheduler lane or the entry lock."""
+        t0 = time.monotonic()
+        snap = self._snapshot_for(oid, query)
+        try:
+            doc = answer(snap)
+        except KeyError as e:
+            raise HTTPError(
+                404, f"unknown class {e.args[0]!r} in {oid}"
+            )
+        doc.update(id=oid, version=snap.version)
+        self.registry.note_read(oid)
+        self.metrics.observe(
+            "distel_read_seconds",
+            time.monotonic() - t0,
+            {"op": op},
+        )
+        return 200, "application/json", _dumps(doc)
+
+    def _ep_q_subsumed(self, oid, *, query, body, deadline_s):
+        sub, sup = query.get("sub"), query.get("sup")
+        if not sub or not sup:
+            raise HTTPError(400, "subsumed needs ?sub=<name>&sup=<name>")
+        return self._read(
+            oid, "subsumed", query,
+            lambda s: {
+                "sub": sub, "sup": sup,
+                "subsumed": s.is_subsumed(sub, sup),
+            },
+        )
+
+    def _ep_q_subsumers(self, oid, *, query, body, deadline_s):
+        cls = query.get("class")
+        if not cls:
+            raise HTTPError(400, "subsumers needs ?class=<name>")
+        return self._read(
+            oid, "subsumers", query,
+            lambda s: {"class": cls, "subsumers": s.subsumers(cls)},
+        )
+
+    def _ep_q_slice(self, oid, *, query, body, deadline_s):
+        cls = query.get("class")
+        if not cls:
+            raise HTTPError(400, "slice needs ?class=<name>")
+        return self._read(
+            oid, "slice", query, lambda s: s.slice(cls)
+        )
+
+    def _ep_q_version(self, oid, *, query, body, deadline_s):
+        return self._read(
+            oid, "version", query,
+            lambda s: {
+                "increment": s.increment,
+                "n_concepts": s.n_concepts,
+                "snapshot_bytes": s.nbytes,
+                "published_unix": s.published_unix,
+            },
+        )
+
     def _ep_healthz(self, *, query, body, deadline_s):
         doc = {
             "status": "draining" if self._closed else "ok",
@@ -511,6 +736,10 @@ class ServeApp:
             "warmup_done": self._warmup_done.is_set(),
             **self.registry.stats(),
         }
+        if self.query is not None:
+            qs = self.query.stats()
+            doc["snapshots"] = qs["snapshots"]
+            doc["snapshot_bytes"] = qs["snapshot_bytes"]
         return 200, "application/json", _dumps(doc)
 
     def _ep_metrics(self, *, query, body, deadline_s):
@@ -533,6 +762,7 @@ class ServeApp:
         if self._closed:
             return []
         self._closed = True
+        self._stop_promoter.set()
         self.flight.record("shutdown", final_spill=final_spill)
         self.scheduler.close()
         spilled = self.registry.spill_all() if final_spill else []

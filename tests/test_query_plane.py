@@ -1,0 +1,472 @@
+"""Query-plane + tiered-storage coverage (ISSUE 11): snapshot read
+semantics against the host taxonomy, version/min_version contracts over
+HTTP, warm/cold tier promotion and demotion (warm promote must skip the
+frontend entirely and beat the cold restore), checksum rejection of a
+corrupted cold spill, compressed-spill size + compatibility, and the
+fleet router's read fan-out with the 412-fallback."""
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+
+import pytest
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.runtime.taxonomy import extract_taxonomy
+from distel_tpu.serve.client import ServeClient, ServeError
+from distel_tpu.serve.query import (
+    OntologySnapshot,
+    SnapshotMiss,
+    SnapshotStore,
+    StaleSnapshot,
+)
+from distel_tpu.serve.registry import (
+    ColdSpillCorrupted,
+    OntologyRegistry,
+)
+from distel_tpu.serve.server import ServeApp, make_server
+from distel_tpu.serve.storage.tiers import TierTraffic
+
+BASE = """
+SubClassOf(A B)
+SubClassOf(B C)
+SubClassOf(C ObjectSomeValuesFrom(r D))
+SubClassOf(ObjectSomeValuesFrom(r D) E)
+EquivalentClasses(E E2)
+SubClassOf(U owl:Nothing)
+"""
+
+
+def _inc(texts):
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = 0
+    for t in texts:
+        inc.add_text(t)
+    return inc
+
+
+# ------------------------------------------------- snapshot semantics
+
+
+def test_snapshot_matches_host_taxonomy():
+    """Every read shape must agree with the host taxonomy at the same
+    closure: subsumers byte-identical, is_subsumed consistent with the
+    (normalized) subsumption relation, equivalents and unsat handled."""
+    inc = _inc([BASE, "SubClassOf(New1 A)"])
+    tax = extract_taxonomy(inc.last_result)
+    store = SnapshotStore()
+    snap = store.publish_result(
+        "o1", inc.last_result, at_least=inc.increment
+    )
+    assert snap.version == 2  # one per increment
+    for name in snap.sig_names:
+        assert snap.subsumers(name) == tax.subsumers[name], name
+        assert snap.equivalents(name) == tax.equivalents[name], name
+    for x in snap.sig_names:
+        subs = set(tax.subsumers[x]) | set(tax.equivalents[x]) | {x}
+        for y in snap.sig_names:
+            assert snap.is_subsumed(x, y) == (y in subs), (x, y)
+    # the slice's subsumees are the strict descendants
+    sl = snap.slice("C")
+    assert "A" in sl["subsumees"] and "B" in sl["subsumees"]
+    assert sl["subsumers"] == tax.subsumers["C"]
+    assert snap.slice("U")["unsatisfiable"] is True
+    with pytest.raises(KeyError):
+        snap.subsumers("NoSuchClass")
+
+
+def test_snapshot_store_versioning_and_staleness():
+    inc = _inc([BASE])
+    store = SnapshotStore()
+    with pytest.raises(SnapshotMiss):
+        store.get("o1")
+    s1 = store.publish_result("o1", inc.last_result, at_least=1)
+    assert store.get("o1").version == 1
+    with pytest.raises(StaleSnapshot):
+        store.get("o1", min_version=2)
+    inc.add_text("SubClassOf(N A)")
+    store.publish_result("o1", inc.last_result, at_least=inc.increment)
+    assert store.get("o1", min_version=2).version == 2
+    # drop keeps the version floor: a re-adopt cannot go backwards
+    store.drop("o1")
+    with pytest.raises(SnapshotMiss):
+        store.get("o1")
+    assert not store.adopt(s1)  # version 1 < floor 2: refused
+    # save/load round-trips the whole read surface
+    import tempfile
+
+    p = os.path.join(tempfile.mkdtemp(), "snap.npz")
+    s2 = store.publish_result(
+        "o1", inc.last_result, at_least=inc.increment
+    )
+    s2.save(p)
+    loaded = OntologySnapshot.load(p)
+    assert loaded.version == s2.version
+    assert loaded.subsumers("N") == s2.subsumers("N")
+    assert store.adopt(loaded)
+
+
+# ------------------------------------------------ HTTP read contract
+
+
+@contextlib.contextmanager
+def _serve(**kw):
+    app = ServeApp(fast_path_min_concepts=0, workers=1, **kw)
+    server = make_server(app)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = ServeClient(
+        f"http://127.0.0.1:{server.server_address[1]}", timeout=300
+    )
+    try:
+        yield app, client
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close(final_spill=False)
+
+
+def test_http_reads_version_contract():
+    with _serve() as (app, c):
+        rec = c.load(BASE)
+        oid = rec["id"]
+        assert rec["version"] == 1  # write acks carry the version
+        r = c.is_subsumed(oid, "A", "C")
+        assert r["subsumed"] is True and r["version"] == 1
+        d = c.delta(oid, "SubClassOf(N A)")
+        assert d["version"] == 2
+        assert c.watermark(oid) == 2  # read-your-writes watermark
+        r = c.query_subsumers(oid, "N")
+        assert r["version"] >= 2
+        assert r["subsumers"] == c.subsumers(oid, "N")["subsumers"]
+        # min_version past the head → 412 with Retry-After
+        c._versions[oid] = 99
+        with pytest.raises(ServeError) as ei:
+            c.snapshot_version(oid)
+        assert ei.value.status == 412
+        assert ei.value.headers.get("Retry-After")
+        c._versions[oid] = 2
+        # unknown ontology vs unknown class
+        with pytest.raises(ServeError) as ei:
+            c.query_subsumers("nope", "A")
+        assert ei.value.status == 404
+        with pytest.raises(ServeError) as ei:
+            c.query_subsumers(oid, "Nope")
+        assert ei.value.status == 404
+        # read metric families render
+        m = c.metrics_text()
+        assert "distel_read_seconds" in m
+        assert "distel_query_snapshots 1" in m
+
+
+def test_query_plane_disabled_by_knob():
+    cfg = ClassifierConfig(query_enable=False)
+    app = ServeApp(cfg, fast_path_min_concepts=0, workers=1)
+    server = make_server(app)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    c = ServeClient(
+        f"http://127.0.0.1:{server.server_address[1]}", timeout=300
+    )
+    try:
+        oid = c.load("SubClassOf(A B)")["id"]
+        assert "version" not in c.load("SubClassOf(X Y)")
+        with pytest.raises(ServeError) as ei:
+            c.is_subsumed(oid, "A", "B")
+        assert ei.value.status == 404
+        # the lane read path still works
+        assert c.subsumers(oid, "A")["subsumers"] == ["B"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close(final_spill=False)
+
+
+# ------------------------------------------------------ storage tiers
+
+
+def test_tier_traffic_victim_and_hottest():
+    t = TierTraffic(halflife_s=60.0)
+    for _ in range(8):
+        t.note_read("hot")
+    t.note_write("lukewarm")
+    t.note_read("lukewarm")
+    assert t.victim(["hot", "lukewarm", "idle"]) == "idle"
+    assert t.hottest(["lukewarm", "hot"]) == "hot"
+    # hottest requires READ traffic: a write-only entry never prefetches
+    t2 = TierTraffic()
+    t2.note_write("w")
+    assert t2.hottest(["w"]) is None
+    t.forget("hot")
+    assert t.score("hot") == 0.0
+
+
+def test_warm_promotion_skips_frontend_and_beats_cold_restore(tmp_path):
+    """The warm tier's reason to exist: promotion re-embeds the host
+    state with NO frontend replay (we make the parser explode to prove
+    it) and is cheaper than the cold restore of the same entry, which
+    must replay every text (plus decompress + checksum)."""
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+
+    store = SnapshotStore()
+    reg = OntologyRegistry(
+        ClassifierConfig(),
+        memory_budget_bytes=1,
+        spill_dir=str(tmp_path),
+        fast_path_min_concepts=0,
+        warm_budget_bytes=1 << 30,
+        query=store,
+    )
+    text = snomed_shaped_ontology(n_classes=150)
+    a = reg.new_id()
+    reg.load(a, text)
+    # a lived: 12 acked deltas.  The COLD restore must replay base +
+    # every delta through the frontend (parse → normalize → re-index
+    # of the accumulated corpus PER TEXT — the real cost of restoring
+    # a long-lived tenant); the WARM promote replays nothing.
+    for i in range(12):
+        reg.delta(a, [f"SubClassOf(WDelta{i} Find{i % 5})"])
+    b = reg.new_id()
+    reg.load(b, "SubClassOf(P Q)")  # budget=1 → demotes a
+    st = reg.tier_stats()
+    assert st["warm_ontologies"] >= 1 and st["warm_bytes"] > 0, st
+    # reads stay served while the write side is demoted
+    assert store.get(a).version == 13  # load + 12 deltas
+    # lift the budget for the measured legs: neither promotion nor
+    # restore may pay eviction work for the OTHER entry (the demote of
+    # b would bill its host fetch to whichever leg ran first)
+    reg.memory_budget_bytes = 1 << 30
+    # warm → hot with the frontend booby-trapped: no parse may happen
+    import distel_tpu.owl.loader as owl_loader
+
+    orig = owl_loader.load
+
+    def _boom(*_a, **_k):
+        raise AssertionError("frontend replay during warm promotion")
+
+    owl_loader.load = _boom
+    try:
+        t0 = time.process_time()
+        inc = reg.classifier(a)
+        warm_cpu = time.process_time() - t0
+    finally:
+        owl_loader.load = orig
+    assert inc.history[-1]["path"] == "promote"
+    tax_warm = extract_taxonomy(inc.last_result).parents
+    # same entry through the COLD path: spill to disk, restore
+    entry = reg._entries[a]
+    with entry.lock:
+        reg._spill(entry)
+    assert entry.warm_inc is None and entry.cold_bytes > 0
+    t0 = time.process_time()
+    inc = reg.classifier(a)
+    cold_cpu = time.process_time() - t0
+    assert inc.history[-1]["path"] == "restore"
+    assert extract_taxonomy(inc.last_result).parents == tax_warm
+    # the acceptance assert: warm promotion is measurably cheaper —
+    # it skips parse+normalize+index of a 300-class corpus, the zlib
+    # inflate, and the checksum pass, all pure CPU.  Compared in
+    # process CPU time: host contention (CI neighbors) cannot skew
+    # it, and the cold leg even REUSES the engine program the warm
+    # promote just built, so the direction is replay cost alone.
+    assert warm_cpu < cold_cpu, (warm_cpu, cold_cpu)
+
+
+def test_cold_spill_checksum_rejection_and_compat(tmp_path):
+    reg = OntologyRegistry(
+        ClassifierConfig(),
+        spill_dir=str(tmp_path),
+        fast_path_min_concepts=0,
+    )
+    oid = reg.new_id()
+    reg.load(oid, BASE)
+    entry = reg._entries[oid]
+    with entry.lock:
+        path = reg._spill(entry)
+    assert os.path.exists(path + ".sha256")
+    # flip one byte mid-file: the restore must refuse loudly
+    with open(path, "r+b") as f:
+        f.seek(64)
+        b = f.read(1)
+        f.seek(64)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(ColdSpillCorrupted):
+        reg.classifier(oid)
+    # pre-checksum-era spill (no sidecar, no recorded sha): restores
+    # unverified — old uncompressed snapshots keep working
+    with entry.lock:
+        entry.inc = None
+        entry.warm_inc = None
+        entry.spill_sha = None
+    os.remove(path + ".sha256")
+    inc = _inc([BASE])
+    inc.snapshot(path, compressed=False)  # old wire form, uncompressed
+    tax = extract_taxonomy(reg.classifier(oid).last_result)
+    assert tax.subsumers["A"] == extract_taxonomy(
+        inc.last_result
+    ).subsumers["A"]
+
+
+def test_compressed_spill_smaller_and_restores_identically(tmp_path):
+    cfg_on = ClassifierConfig()  # storage.compress.spills defaults ON
+    assert cfg_on.storage_compress_spills is True
+    reg = OntologyRegistry(
+        cfg_on, spill_dir=str(tmp_path), fast_path_min_concepts=0
+    )
+    oid = reg.new_id()
+    reg.load(oid, BASE)
+    entry = reg._entries[oid]
+    tax_before = extract_taxonomy(
+        reg.classifier(oid).last_result
+    ).parents
+    with entry.lock:
+        reg._spill(entry)
+    sz_c = os.path.getsize(entry.spill_path)
+    tax_c = extract_taxonomy(reg.classifier(oid).last_result).parents
+    assert tax_c == tax_before
+    reg.config = dataclasses.replace(
+        reg.config, storage_compress_spills=False
+    )
+    with entry.lock:
+        reg._spill(entry)
+    sz_u = os.path.getsize(entry.spill_path)
+    tax_u = extract_taxonomy(reg.classifier(oid).last_result).parents
+    assert tax_u == tax_before
+    assert sz_c < sz_u, (sz_c, sz_u)
+
+
+def test_prefetch_promotes_read_hottest(tmp_path):
+    reg = OntologyRegistry(
+        ClassifierConfig(),
+        memory_budget_bytes=1,
+        spill_dir=str(tmp_path),
+        fast_path_min_concepts=0,
+        warm_budget_bytes=1 << 30,
+    )
+    a = reg.new_id()
+    reg.load(a, "SubClassOf(A B)")
+    b = reg.new_id()
+    reg.load(b, "SubClassOf(P Q)")
+    # both demoted under the 1-byte budget except the most recent
+    assert reg.tier_stats()["warm_ontologies"] >= 1
+    # no read traffic → nothing to prefetch even with headroom
+    reg.memory_budget_bytes = 1 << 30
+    assert reg.maybe_prefetch() is None
+    for _ in range(3):
+        reg.note_read(a)
+    got = reg.maybe_prefetch()
+    assert got == a
+    assert reg._entries[a].inc is not None  # genuinely hot again
+    # flight/event plumbing exercised; promoting again is a no-op
+    assert reg.maybe_prefetch() is None
+
+
+# ------------------------------------------------- router read fan-out
+
+
+@contextlib.contextmanager
+def _fleet(tmp_path, n=2, **router_kw):
+    from distel_tpu.serve.fleet.replica import ReplicaApp
+    from distel_tpu.serve.fleet.router import RouterApp
+
+    spill = str(tmp_path / "spill")
+    apps, servers, replicas = [], [], []
+    for i in range(n):
+        app = ReplicaApp(
+            replica_id=f"r{i}", spill_dir=spill,
+            fast_path_min_concepts=0,
+        )
+        srv = make_server(app)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        apps.append(app)
+        servers.append(srv)
+        replicas.append(
+            (f"r{i}", f"http://127.0.0.1:{srv.server_address[1]}")
+        )
+    router = RouterApp(replicas, **router_kw)
+    rsrv = make_server(router)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    client = ServeClient(
+        f"http://127.0.0.1:{rsrv.server_address[1]}", timeout=300
+    )
+    try:
+        yield router, client, apps
+    finally:
+        router.close()
+        for s in servers + [rsrv]:
+            s.shutdown()
+            s.server_close()
+        for a in apps:
+            a.close(final_spill=False)
+
+
+def test_router_read_fanout_and_stale_fallback(tmp_path):
+    """Replication puts a read-only snapshot on a peer; reads
+    round-robin over the read set; a write makes the peer lag, and the
+    client's min_version watermark forces the router's 412-fallback to
+    the primary — the client never sees the lag."""
+    with _fleet(tmp_path) as (router, c, apps):
+        oid = c.load(BASE)["id"]
+        rec = router.replicate(oid)
+        assert rec["version"] == 1
+        for _ in range(6):
+            assert c.is_subsumed(oid, "A", "C")["subsumed"] is True
+        counts = {
+            a.replica_id: a.metrics.counter_value(
+                "distel_requests_total",
+                {
+                    "endpoint":
+                        "/v1/ontologies/{id}/query/subsumed",
+                    "code": "200",
+                },
+            )
+            for a in apps
+        }
+        assert all(v > 0 for v in counts.values()), counts
+        # write → peer lags → watermarked reads fall back to primary
+        d = c.delta(oid, "SubClassOf(N A)")
+        assert d["version"] == 2
+        want = c.subsumers(oid, "N")["subsumers"]  # lane-path parity
+        for _ in range(4):
+            r = c.query_subsumers(oid, "N")
+            assert r["subsumers"] == want
+            assert r["version"] >= 2
+        assert (
+            router.metrics.counter_value(
+                "distel_router_read_fallbacks_total"
+            )
+            > 0
+        )
+        # re-replication refreshes the peer; fallbacks stop growing
+        router.replicate(oid, dst_rid=rec["to"])
+        fb0 = router.metrics.counter_value(
+            "distel_router_read_fallbacks_total"
+        )
+        for _ in range(4):
+            c.query_subsumers(oid, "N")
+        assert (
+            router.metrics.counter_value(
+                "distel_router_read_fallbacks_total"
+            )
+            == fb0
+        )
+
+
+def test_router_reads_survive_migration_with_version_continuity(
+    tmp_path,
+):
+    """Reads keep answering across a live migration — including a
+    migration ONTO a replica that held only a stale read-only copy —
+    and the client watermark never forces a permanent 412."""
+    with _fleet(tmp_path) as (router, c, apps):
+        oid = c.load(BASE)["id"]
+        rep = router.replicate(oid)
+        d = c.delta(oid, "SubClassOf(N A)")  # peer copy now stale
+        rec = router.migrate(oid, dst_rid=rep["to"])
+        assert rec["to"] == rep["to"]
+        r = c.query_subsumers(oid, "N")
+        assert r["version"] >= d["version"]
+        # and the lane answers stay byte-identical across the move
+        assert c.subsumers(oid, "N")["subsumers"] == r["subsumers"]
+        assert "A" in r["subsumers"] and "C" in r["subsumers"]

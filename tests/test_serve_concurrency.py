@@ -188,6 +188,92 @@ def test_registry_export_serializes_after_inflight_delta(tmp_path):
         reg.adopt(oid, exported["texts"], spill_path=exported["spill"])
 
 
+# ------------------------------- snapshot reads vs a live delta writer
+
+
+def test_snapshot_reads_consistent_under_delta_writes():
+    """The query-plane consistency contract (ISSUE 11): reader threads
+    hammer the lock-free /query endpoints while a writer applies deltas
+    in a loop.  Every response must be internally consistent — version
+    MONOTONIC per client, and the subsumer set byte-identical to a
+    closure recomputed from exactly the texts acknowledged at that
+    version (never a torn mix of two versions)."""
+    app = ServeApp(fast_path_min_concepts=0, workers=1)
+    server = make_server(app)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    writer_client = ServeClient(url, timeout=300)
+    try:
+        rec = writer_client.load(BASE)
+        oid = rec["id"]
+        # version → the exact text prefix acknowledged at it.  The
+        # writer applies deltas ONE at a time (sequential acks, no
+        # coalescing possible), so version k ⇔ first k texts.
+        texts_at = {rec["version"]: [BASE]}
+        stop = threading.Event()
+        observations = []  # (version, frozenset(subsumers)) per read
+        failures = []
+
+        def reader(k):
+            c = ServeClient(url, timeout=300)
+            last = 0
+            while not stop.is_set():
+                try:
+                    r = c.query_subsumers(oid, "A")
+                    s = c.is_subsumed(oid, "A", "C")
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append((k, repr(e)))
+                    return
+                for v in (r["version"], s["version"]):
+                    if v < last:
+                        failures.append(
+                            (k, f"version went back: {last}->{v}")
+                        )
+                        return
+                    last = max(last, v)
+                if not s["subsumed"]:
+                    failures.append((k, "A ⊑ C lost"))
+                    return
+                observations.append(
+                    (r["version"], tuple(r["subsumers"]))
+                )
+
+        readers = [
+            threading.Thread(target=reader, args=(k,)) for k in range(3)
+        ]
+        for t in readers:
+            t.start()
+        for i in range(6):
+            text = f"SubClassOf(A W{i})\nSubClassOf(W{i} W{i}x)"
+            rec = writer_client.delta(oid, text)
+            texts_at[rec["version"]] = (
+                texts_at[max(texts_at)] + [text]
+            )
+        stop.set()
+        for t in readers:
+            t.join(timeout=300)
+        assert failures == []
+        assert observations
+        # every observed (version, subsumers) pair must equal a closure
+        # recomputed from exactly that version's acknowledged texts
+        seen = {}
+        for v, subs in observations:
+            prev = seen.setdefault(v, subs)
+            assert prev == subs, (
+                f"torn read at version {v}: {prev} vs {subs}"
+            )
+        for v, subs in sorted(seen.items()):
+            assert v in texts_at, (v, sorted(texts_at))
+            want = tuple(_direct_subsumers(texts_at[v], "A"))
+            assert subs == want, (v, subs, want)
+        # the readers actually spanned multiple versions
+        assert len(seen) >= 2, sorted(seen)
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close(final_spill=False)
+
+
 # ------------------------------------------- queue-full under a herd
 
 
