@@ -457,6 +457,193 @@ def run_delta_steady_state(
             os.environ[env_key] = prev
 
 
+def run_cohort_ab(
+    *,
+    sizes=(1, 2, 4, 8),
+    deltas: int = 10,
+    wait_ms: float = 100.0,
+    label: str = "cohort-ab",
+) -> dict:
+    """The cohort A/B (ISSUE 12): N same-bucket tenants firing
+    concurrent steady deltas against one in-process ServeApp, run
+    twice per size — ``inline`` (cohort.enable=false: one device
+    dispatch per tenant per vote, the pre-cohort behavior) vs
+    ``cohort`` (the formation lane groups them and one vmapped
+    dispatch advances the whole cohort).  Records per-tenant delta
+    p50/p99, aggregate delta throughput, and the MEASURED dispatch
+    counts from the process-global ``COHORT_EVENTS`` tally — on a CPU
+    host the dispatch collapse is the honest headline (each vmapped
+    dispatch still executes its lanes serially on one core; the
+    MXU-utilization win needs a TPU host), so the record reports both
+    and lets neither impersonate the other."""
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.instrumentation import COHORT_EVENTS
+    from distel_tpu.serve.client import ServeClient
+    from distel_tpu.serve.server import ServeApp, make_server
+
+    def leg(k: int, mode: str) -> dict:
+        cfg = ClassifierConfig(
+            cohort_enable=(mode == "cohort"),
+            cohort_max_size=max(k, 2),
+            cohort_max_wait_ms=wait_ms,
+        )
+        app = server = None
+        try:
+            app = ServeApp(cfg, workers=2, fast_path_min_concepts=0)
+            server = make_server(app, port=0)
+            threading.Thread(
+                target=server.serve_forever, daemon=True
+            ).start()
+            url = f"http://127.0.0.1:{server.server_address[1]}"
+            base = ServeClient(url, timeout=600)
+            oids = [base.load(_mk_ontology(i))["id"] for i in range(k)]
+            for i, oid in enumerate(oids):
+                base.delta(oid, f"SubClassOf(T{i}Warm T{i}C0)")
+
+            def delta_text(i, j):
+                if j % 3 == 2:
+                    return (
+                        f"SubClassOf(T{i}L{j} "
+                        f"ObjectSomeValuesFrom(r{i} T{i}C1))"
+                    )
+                return f"SubClassOf(T{i}S{j} T{i}C0)"
+
+            failures: list = []
+
+            def fire(round_ids, record):
+                threads = []
+                for i in round_ids:
+                    def w(i=i):
+                        c = ServeClient(url, timeout=600)
+                        for j in range(deltas):
+                            t0 = time.monotonic()
+                            try:
+                                rec = c.delta(
+                                    oids[i], delta_text(i, j)
+                                )
+                            except Exception as e:  # noqa: BLE001
+                                # a swallowed failure would let a
+                                # partial run impersonate a clean one
+                                # in the record — count it and keep
+                                # the other deltas flowing
+                                failures.append((i, j, repr(e)))
+                                continue
+                            if record is not None:
+                                record.append(
+                                    (time.monotonic() - t0,
+                                     rec.get("path"))
+                                )
+                    threads.append(threading.Thread(target=w))
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+
+            # un-timed warm round: first-formation cohort compiles (or
+            # their inline equivalents) pay OUTSIDE the measured
+            # window — the steady state is the regime under test
+            warm_rec: list = []
+            fire(range(k), warm_rec)
+            samples: list = []
+            before = COHORT_EVENTS.snapshot()
+            t0 = time.monotonic()
+            fire(range(k), samples)
+            wall = time.monotonic() - t0
+            after = COHORT_EVENTS.snapshot()
+            walls = sorted(s[0] for s in samples)
+            n = len(samples)
+            disp = {
+                key: after[key] - before[key]
+                for key in (
+                    "solo_dispatches",
+                    "cohort_dispatches",
+                    "cohort_tenant_votes",
+                    "cohort_deltas",
+                )
+            }
+            return {
+                "mode": mode,
+                "tenants": k,
+                "deltas": n,
+                "failed_requests": len(failures),
+                "failures_sample": failures[:5],
+                "wall_s": round(wall, 2),
+                "delta_p50_ms": round(1e3 * _pct(walls, 0.50), 1),
+                "delta_p99_ms": round(1e3 * _pct(walls, 0.99), 1),
+                "throughput_deltas_s": round(n / wall, 2),
+                "cohort_paths": sum(
+                    1 for _w, p in samples if p == "cohort"
+                ),
+                "dispatches": disp,
+                "formed": app.metrics.counter_value(
+                    "distel_cohort_formed_total"
+                ),
+                "fallbacks": app.metrics.counter_value(
+                    "distel_cohort_fallback_total"
+                ),
+            }
+        finally:
+            if server is not None:
+                server.shutdown()
+                server.server_close()
+            if app is not None:
+                app.close(final_spill=False)
+
+    out = {
+        "scenario": label,
+        "tenant_deltas": deltas,
+        "max_wait_ms": wait_ms,
+        "note": (
+            "the headline on a CPU host is the DISPATCH COLLAPSE "
+            "(dispatches_per_delta: one vmapped launch advances the "
+            "whole cohort where inline pays one per tenant), counted "
+            "from the process-global tally.  Wall-clock throughput on "
+            "a CPU host can read BELOW 1x: the vmapped program still "
+            "executes its lanes serially on the same cores, and the "
+            "canonical cohort roster adds inert votes (cross program, "
+            "extra quiet cycles) that inline execution skips — the "
+            "MXU-utilization win this path exists for needs a TPU "
+            "host, where the batch dimension feeds otherwise-idle "
+            "systolic array rows instead of a busy scalar core."
+        ),
+        "sizes": {},
+    }
+    for k in sizes:
+        print(f"# cohort A/B at {k} tenant(s)…", file=sys.stderr)
+        inline = leg(k, "inline")
+        co = leg(k, "cohort")
+        run_disp = co["dispatches"]["solo_dispatches"] + co[
+            "dispatches"
+        ]["cohort_dispatches"]
+        out["sizes"][f"x{k}"] = {
+            "inline": inline,
+            "cohort": co,
+            # run-program dispatches per steady delta, both legs — the
+            # N→1 collapse reads directly off these
+            "dispatches_per_delta_inline": round(
+                inline["dispatches"]["solo_dispatches"]
+                / max(inline["deltas"], 1),
+                2,
+            ),
+            "dispatches_per_delta_cohort": round(
+                run_disp / max(co["deltas"], 1), 2
+            ),
+            "throughput_speedup_x": round(
+                co["throughput_deltas_s"]
+                / max(inline["throughput_deltas_s"], 1e-9),
+                2,
+            ),
+        }
+    # scenario-level rollup so the doc's zero_failed_requests claim
+    # covers this scenario like every other
+    out["failed_requests"] = sum(
+        rec[leg]["failed_requests"]
+        for rec in out["sizes"].values()
+        for leg in ("inline", "cohort")
+    )
+    return out
+
+
 class _ReadWorker(threading.Thread):
     """One read client hammering a single ontology.  ``mode`` picks the
     path: "snapshot" uses the lock-free /query endpoints, "lane" the
@@ -846,6 +1033,20 @@ def main(argv=None) -> int:
                     help="deltas per delta-steady-state leg")
     ap.add_argument("--delta-classes", type=int, default=600,
                     help="base ontology size for delta-steady-state")
+    ap.add_argument("--cohort", action="store_true",
+                    help="cohort A/B (ISSUE 12): N same-bucket tenants "
+                         "firing concurrent deltas, inline vs cohort "
+                         "execution at sizes 1/2/4/8 — per-tenant "
+                         "p50/p99, aggregate delta throughput, and the "
+                         "measured device-dispatch collapse")
+    ap.add_argument("--cohort-sizes", type=int, nargs="*",
+                    default=[1, 2, 4, 8],
+                    help="tenant counts for the cohort A/B")
+    ap.add_argument("--cohort-deltas", type=int, default=10,
+                    help="steady deltas per tenant per cohort A/B leg")
+    ap.add_argument("--cohort-wait-ms", type=float, default=100.0,
+                    help="cohort formation wait (cohort.max_wait_ms) "
+                         "for the cohort legs")
     ap.add_argument("--read-heavy", action="store_true",
                     help="read-plane A/B: N readers on one ontology "
                          "concurrent with steady delta traffic — "
@@ -896,6 +1097,14 @@ def main(argv=None) -> int:
             )
             print(json.dumps(rec), flush=True)
             scenarios.append(rec)
+    if args.cohort:
+        rec = run_cohort_ab(
+            sizes=tuple(args.cohort_sizes),
+            deltas=args.cohort_deltas,
+            wait_ms=args.cohort_wait_ms,
+        )
+        print(json.dumps(rec), flush=True)
+        scenarios.append(rec)
     if args.read_heavy:
         rec = run_read_heavy(
             readers=args.readers,
@@ -955,6 +1164,21 @@ def main(argv=None) -> int:
             "compile_ms_per_delta_bucketed": b["compile_mean_ms"],
             "steady_hit_rate_bucketed": b["program_cache_hit_rate"],
         }
+    cohort_summary = None
+    for s in scenarios:
+        if s.get("scenario") == "cohort-ab":
+            cohort_summary = {
+                size: {
+                    "dispatches_per_delta_inline": rec[
+                        "dispatches_per_delta_inline"
+                    ],
+                    "dispatches_per_delta_cohort": rec[
+                        "dispatches_per_delta_cohort"
+                    ],
+                    "throughput_speedup_x": rec["throughput_speedup_x"],
+                }
+                for size, rec in s["sizes"].items()
+            }
     doc = {
         "bench": "bench_serve",
         "metric": "aggregate_classify_throughput_ops_s",
@@ -975,6 +1199,11 @@ def main(argv=None) -> int:
         **(
             {"delta_steady_state": delta_summary}
             if delta_summary is not None
+            else {}
+        ),
+        **(
+            {"cohort_ab": cohort_summary}
+            if cohort_summary is not None
             else {}
         ),
         "zero_failed_requests": all(

@@ -212,6 +212,26 @@ class ClassifierConfig:
     #: decoded-row LRU capacity per snapshot (subsumer/slice reads
     #: decode one wire row lazily; repeat reads of hot classes hit RAM)
     query_row_cache: int = 256
+    #: cross-tenant cohort execution (ISSUE 12): the scheduler groups
+    #: compatible pending delta requests by bucket signature and the
+    #: registry advances the whole cohort under ONE vmapped device
+    #: dispatch per vote (states stacked on a leading tenant axis) —
+    #: per-tenant results byte-identical to solo execution.  Off: every
+    #: delta dispatches inline per tenant (the pre-cohort behavior).
+    cohort_enable: bool = True
+    #: largest cohort one dispatch advances; cohort programs compile
+    #: per power-of-two rung (a cohort of 3 pads to 4), so this also
+    #: bounds the cohort-program population
+    cohort_max_size: int = 8
+    #: bounded formation wait: how long a delta at the head of its lane
+    #: holds for same-bucket companions before dispatching anyway (the
+    #: classic batching latency/throughput trade — keep it well under a
+    #: typical delta's service time)
+    cohort_max_wait_ms: float = 25.0
+    #: comma-separated cohort sizes ``warm_delta_programs`` AOTs for
+    #: the canonical delta rosters ("" = skip cohort warmup): a warmed
+    #: replica's FIRST cohort then dispatches compile-free
+    cohort_warm_sizes: str = ""
     #: compress registry cold spills (``np.savez_compressed``) — ~8x
     #: smaller on disk for sparse closures (941 MB → low hundreds at
     #: 64k, see ADVICE.md) at the price of zlib wall on the spill;
@@ -330,6 +350,14 @@ class ClassifierConfig:
             cfg.query_enable = raw["query.enable"].lower() == "true"
         if "query.row.cache" in raw:
             cfg.query_row_cache = int(raw["query.row.cache"])
+        if "cohort.enable" in raw:
+            cfg.cohort_enable = raw["cohort.enable"].lower() == "true"
+        if "cohort.max_size" in raw:
+            cfg.cohort_max_size = int(raw["cohort.max_size"])
+        if "cohort.max_wait_ms" in raw:
+            cfg.cohort_max_wait_ms = float(raw["cohort.max_wait_ms"])
+        if "cohort.warm.sizes" in raw:
+            cfg.cohort_warm_sizes = raw["cohort.warm.sizes"]
         if "storage.compress.spills" in raw:
             cfg.storage_compress_spills = (
                 raw["storage.compress.spills"].lower() == "true"
@@ -350,6 +378,14 @@ class ClassifierConfig:
             if k.startswith("backend."):  # backend.CR1 = tpu
                 cfg.rule_backends[k[len("backend."):]] = v
         return cfg
+
+    def cohort_warm_size_list(self) -> list:
+        """Parsed ``cohort.warm.sizes`` (empty = no cohort warmup)."""
+        return [
+            int(s)
+            for s in self.cohort_warm_sizes.replace(",", " ").split()
+            if s
+        ]
 
     def sparse_tail_config(self) -> Optional[dict]:
         """The rowpacked engine's ``sparse_tail=`` kwarg for this config

@@ -1,0 +1,373 @@
+"""Cross-tenant cohort execution: one device dispatch advances N
+same-bucket tenants (ISSUE 12).
+
+The serving walls BENCH_SERVE_r01/r02 left standing are host-side:
+fleet throughput scales by adding replica processes (one GIL each) and
+the steady-delta path still pays ONE device dispatch PER TENANT even
+though PR 10 made same-bucket tenants share one compiled executable.
+This module adds the missing leading axis: stack the packed states of N
+same-bucket tenants and ``jax.vmap`` the bucketed fixed point, so a
+single launch saturates or delta-classifies a whole cohort — on a TPU
+host that turns "replicas × GIL" into "MXU utilization × batch"; on
+this CPU host the measured win is the N→1 dispatch collapse itself
+(asserted via :data:`~distel_tpu.runtime.instrumentation.COHORT_EVENTS`,
+never inferred from wall clocks).
+
+Why this is sound, and byte-identical to solo execution:
+
+* a BUCKETED engine's traced program is a pure function of its
+  ``bucket_signature`` — every ontology-derived array (rule tables,
+  gather indices, window slabs, the live-column mask) rides in the
+  runtime-argument pytree.  vmapping that program over stacked states
+  AND stacked argument pytrees evaluates each tenant's exact solo
+  computation elementwise along the leading axis; the state is uint32
+  bit-algebra and integer matmuls, so there is no float reassociation
+  to diverge under batching.
+* divergent per-tenant convergence is handled by jax's ``while_loop``
+  batching rule, which IS the live-tenant mask: the loop runs while ANY
+  lane's cond holds and the carry is ``select``-masked per lane, so a
+  converged member's state rides unchanged (and its iteration counter
+  frozen) while the stragglers drain — monotone EL+ saturation makes
+  the extra evaluations fixed-point no-ops regardless.
+* cohort sizes quantize to a power-of-two ladder (pad members repeat
+  the last live tenant, results discarded), so the compiled cohort
+  program is a pure function of ``(bucket_signature, rung, budget)`` —
+  shared through ``core/program_cache.PROGRAMS`` and the persistent
+  HLO cache exactly like the solo programs, and AOT-able by
+  ``runtime/warmup.warm_delta_programs``.
+
+The delta-plane entry point (:func:`execute_delta_cohort`) replays the
+incremental fast path's round-robin joint fixed point
+(``IncrementalClassifier._execute_delta_plan``) with one vmapped
+dispatch per vote: every tenant runs the identical vote sequence it
+would run solo (same roster positions, same per-vote budgets), with
+per-tenant iteration/derivation accounting frozen at the vote where the
+solo loop would have retired it — so closures, iteration counts and
+history records all match solo execution bit for bit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distel_tpu.core.engine import (
+    SaturationResult,
+    _host_bit_total,
+    _pad_up,
+)
+from distel_tpu.core.program_cache import PROGRAMS
+from distel_tpu.runtime.instrumentation import (
+    COHORT_EVENTS,
+    CompileStats,
+    compile_watch,
+)
+
+
+def cohort_rung(n: int) -> int:
+    """Smallest power of two >= ``n`` — the cohort-size ladder.  A
+    fixed global ladder (like ``bucket_dim``'s geometric one) keeps the
+    compiled-program population bounded: a cohort of 3 pads to 4, of 5
+    to 8, and every rung's program is shared across all cohorts that
+    quantize to it."""
+    if n < 1:
+        raise ValueError(f"cohort needs at least one member, got {n}")
+    r = 1
+    while r < n:
+        r <<= 1
+    return r
+
+
+def cohort_ready(engine) -> bool:
+    """Whether ``engine``'s programs can run under a cohort dispatch:
+    single-device (the vmapped program has no shard_map port yet) and
+    shape-bucketed (an exact-mode program embeds ontology constants, so
+    stacking DIFFERENT tenants under it would be unsound)."""
+    return engine.mesh is None and getattr(engine, "_bucket", False)
+
+
+def _stack_masks(engines) -> dict:
+    """Stack N same-signature engines' runtime-argument pytrees along a
+    new leading axis.  Equal bucket signatures guarantee equal treedefs
+    and leaf shapes (the signature hashes the argument avals)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[e._masks for e in engines]
+    )
+
+
+def _cohort_avals(leader, rung: int):
+    u32 = jnp.uint32
+    sp_av = jax.ShapeDtypeStruct((rung, leader.nc, leader.wc), u32)
+    rp_av = jax.ShapeDtypeStruct((rung, leader.nl, leader.wc), u32)
+    mk_av = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct((rung,) + tuple(a.shape), a.dtype),
+        leader._mask_avals(),
+    )
+    return sp_av, rp_av, mk_av
+
+
+def cohort_run_exe(leader, rung: int, budget: int):
+    """The compiled cohort fixed point for ``leader``'s bucket at
+    ``rung`` tenants and ``budget`` iterations: ``vmap`` of the solo
+    run program (same-shape embed fused in front, matching what each
+    solo vote's ``embed_state`` does), registry-shared under
+    ``(bucket_signature, "cohort_run", budget, rung)``.  Returns
+    ``(executable, CompileStats)`` — the stats record whether THIS
+    lookup hit the registry (the steady-state compile-free claim is
+    asserted off them)."""
+    if not cohort_ready(leader):
+        raise ValueError(
+            "cohort programs need a single-device shape-bucketed engine"
+        )
+    stats = CompileStats(
+        bucket_signature=leader.bucket_signature,
+        program=f"cohort_run[{rung}x{budget}]",
+    )
+    sp_av, rp_av, mk_av = _cohort_avals(leader, rung)
+
+    def one(sp, rp, masks):
+        # the same-shape embed every solo vote applies (embed_state on
+        # matching dims reduces to: S |= fresh-init diagonal + ⊤ row, R
+        # verbatim) — fused here so a cohort vote stays ONE dispatch
+        sp0, _ = leader._initial_arrays()
+        return leader._run(sp0 | sp, rp, masks, budget)
+
+    def build():
+        t0 = time.perf_counter()
+        lowered = jax.jit(
+            jax.vmap(one), donate_argnums=(0, 1)
+        ).lower(sp_av, rp_av, mk_av)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        stats.trace_lower_s = t1 - t0
+        stats.compile_s = time.perf_counter() - t1
+        return compiled
+
+    with compile_watch(stats):
+        key = (leader.bucket_signature, "cohort_run", budget, rung)
+        exe, hit = PROGRAMS.get_or_build(key, build)
+        stats.program_cache_hit = hit
+    leader._note_compile(stats)
+    return exe, stats
+
+
+def _cohort_count_exe(leader, rung: int):
+    """Vmapped embed+count program: per-tenant live-bit totals of the
+    embedded states in one dispatch (the cohort analog of the solo
+    loop's ``count_live_bits`` bracketing).  Shape-keyed like the solo
+    count/embed programs: the traced body depends only on the state
+    dims and TOP_ID."""
+    key = (
+        "shape:cohort_embed_count", rung, leader.nc, leader.nl, leader.wc,
+    )
+
+    def one(sp, rp, w):
+        sp0, _ = leader._initial_arrays()
+        return leader._live_bits(sp0 | sp, rp, wmask=w)
+
+    def build():
+        u32 = jnp.uint32
+        sp_av, rp_av, _ = _cohort_avals(leader, rung)
+        w_av = jax.ShapeDtypeStruct((rung, leader.wc), u32)
+        return jax.jit(jax.vmap(one)).lower(sp_av, rp_av, w_av).compile()
+
+    exe, _hit = PROGRAMS.get_or_build(key, build)
+    return exe
+
+
+def delta_cohort_ready(inc, plan) -> bool:
+    """Whether one tenant's planned increment can join a cohort
+    dispatch: bucketed delta programs, single-device bucketed roster,
+    and a device-resident packed closure already in the base layout
+    (the stacking precondition — a host/numpy or differently shaped
+    state takes the solo path)."""
+    if plan is None or not plan.bucketed:
+        return False
+    if not all(cohort_ready(e) for e in plan.engines):
+        return False
+    state = inc._state
+    if state is None:
+        return False
+    sp, rp = state
+    base = plan.base
+    return (
+        isinstance(sp, jax.Array)
+        and sp.dtype == jnp.uint32
+        and tuple(sp.shape) == (base.nc, base.wc)
+        and tuple(rp.shape) == (base.nl, base.wc)
+    )
+
+
+def execute_delta_cohort(
+    members: Sequence[Tuple[object, object, object]],
+    max_iters: Optional[int] = None,
+) -> List[SaturationResult]:
+    """Advance N tenants' planned increments under shared vmapped
+    dispatches and complete each increment.
+
+    ``members``: ``(classifier, plan, batch)`` triples — ingested and
+    planned (``_ingest`` + ``_delta_fast_plan``) but not yet executed,
+    all passing :func:`delta_cohort_ready` with EQUAL
+    ``plan.roster_key()`` (the caller groups; this function verifies).
+    Each member's closure, iteration count and history record come out
+    byte-identical to solo execution of the same plan; returns the
+    per-member results in order."""
+    if len(members) < 2:
+        raise ValueError("a cohort needs at least 2 members")
+    incs = [m[0] for m in members]
+    plans = [m[1] for m in members]
+    batches = [m[2] for m in members]
+    key0 = plans[0].roster_key()
+    for inc, plan in zip(incs, plans):
+        if plan.roster_key() != key0:
+            raise ValueError(
+                "cohort members must share one roster key "
+                f"({plan.roster_key()} != {key0})"
+            )
+        if not delta_cohort_ready(inc, plan):
+            raise ValueError("member not cohort-ready (stale grouping?)")
+    n = len(members)
+    rung = cohort_rung(n)
+    pad = rung - n
+    k = len(plans[0].engines)
+    if max_iters is None:
+        max_iters = incs[0].config.max_iterations
+    for inc in incs:
+        inc.last_result = None
+        inc.last_compile = None
+        inc.last_delta_stats = None
+    # stack the tenants' packed closures (pad lanes repeat the last
+    # live tenant: they converge identically and are sliced away)
+    states = [inc._pop_state() for inc in incs]
+    sps = jnp.stack([s for s, _ in states] + [states[-1][0]] * pad)
+    rps = jnp.stack([r for _, r in states] + [states[-1][1]] * pad)
+    del states
+    lead0 = plans[0].engines[0]
+    wmasks = jnp.stack(
+        [jnp.asarray(p.engines[0]._wmask) for p in plans]
+        + [jnp.asarray(plans[-1].engines[0]._wmask)] * pad
+    )
+    count_exe = _cohort_count_exe(lead0, rung)
+    start_bits = np.asarray(count_exe(sps, rps, wmasks))
+    start_totals = [_host_bit_total(start_bits[i]) for i in range(n)]
+
+    # ---- the joint round-robin fixed point, one dispatch per vote.
+    # Per-tenant accounting mirrors _execute_delta_plan exactly: a
+    # tenant retires at streak == k and stops counting; its later votes
+    # are monotone no-ops riding the batch (the live-tenant mask is
+    # jax's while_loop batching select — see module docstring).
+    exes: dict = {}
+    masks_by_pos: dict = {}
+    builds: List[CompileStats] = []
+    iters = [0] * n
+    streaks = [0] * n
+    votes = 0
+    ei = 0
+    while min(streaks) < k:
+        pos = ei % k
+        ei += 1
+        engines_j = [p.engines[pos] for p in plans]
+        if pos not in exes:
+            budget_j = _pad_up(max_iters, engines_j[0].unroll)
+            exe, stats = cohort_run_exe(engines_j[0], rung, budget_j)
+            exes[pos] = exe
+            builds.append(stats)
+            # the runtime-argument pytrees never change across votes
+            # (any closure rebind happened at plan time), so one stack
+            # per position serves the whole joint loop
+            masks_by_pos[pos] = _stack_masks(
+                engines_j + [engines_j[-1]] * pad
+            )
+        live = sum(1 for s in streaks if s < k)
+        sps, rps, its, _changed, _bits = exes[pos](
+            sps, rps, masks_by_pos[pos]
+        )
+        votes += 1
+        COHORT_EVENTS.record_cohort(size=live, rung=rung)
+        its = np.asarray(its)
+        for i in range(n):
+            if streaks[i] >= k:
+                continue  # retired: this vote is a no-op for tenant i
+            it_i = int(its[i])
+            iters[i] += it_i
+            unproductive = it_i <= engines_j[i].unroll
+            streaks[i] = streaks[i] + 1 if unproductive else 0
+    final_bits = np.asarray(count_exe(sps, rps, wmasks))
+
+    # ---- program-cost accounting: the cohort programs are built once
+    # and shared — the build (if any) is charged to member 0; later
+    # members ride registry-resident programs by construction
+    all_hit = all(st.program_cache_hit for st in builds)
+    results = []
+    for i, (inc, plan, batch) in enumerate(members):
+        agg = CompileStats(
+            bucket_signature=plan.base.bucket_signature,
+            program="cohort-delta-programs",
+        )
+        if i == 0:
+            for st in builds:
+                agg.trace_lower_s += st.trace_lower_s
+                agg.compile_s += st.compile_s
+                agg.persistent_cache_hits += st.persistent_cache_hits
+                agg.persistent_cache_misses += st.persistent_cache_misses
+        agg.program_cache_hit = all_hit if i == 0 else True
+        inc.last_compile = agg
+        inc.last_delta_stats = {
+            "delta_bucketed": True,
+            # cohort variants of every roster position, base included
+            # (unlike the solo record, whose base program was charged
+            # to the rebuild that built it, the base's COHORT program
+            # is a product of this path)
+            "delta_programs": len(builds),
+            # members past the first ride programs that were registry-
+            # resident by their execution (the leader's builds) — they
+            # report full hits so the fleet-wide hit/miss counters sum
+            # one miss per actual build, not one per member
+            "delta_program_hits": (
+                sum(bool(st.program_cache_hit) for st in builds)
+                if i == 0
+                else len(builds)
+            ),
+            "delta_signature": plan.engines[0].bucket_signature,
+            "cohort_size": n,
+            "cohort_rung": rung,
+            "cohort_dispatches": votes,
+        }
+        result = SaturationResult(
+            packed_s=sps[i],
+            packed_r=rps[i],
+            iterations=iters[i],
+            derivations=_host_bit_total(final_bits[i]) - start_totals[i],
+            idx=plan.idx,
+            converged=True,
+            transposed=True,
+        )
+        results.append(inc._finish_increment(batch, result, "cohort"))
+    COHORT_EVENTS.record_deltas(n)
+    return results
+
+
+def warm_cohort_programs(
+    engines, sizes: Sequence[int], max_iters: int
+) -> List[dict]:
+    """AOT the cohort run programs for an engine roster at the given
+    cohort sizes (quantized to the pow2 ladder) — the cohort half of
+    the warmup precompile: after this, even the FIRST cohort a
+    restarted replica forms dispatches compile-free.  Returns one
+    record per (engine, rung) build."""
+    out = []
+    rungs = sorted({cohort_rung(int(s)) for s in sizes if int(s) >= 2})
+    for eng in engines:
+        if not cohort_ready(eng):
+            continue
+        budget = _pad_up(max_iters, eng.unroll)
+        for rung in rungs:
+            _exe, stats = cohort_run_exe(eng, rung, budget)
+            rec = stats.as_dict()
+            rec["rung"] = rung
+            out.append(rec)
+    return out

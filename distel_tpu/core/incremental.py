@@ -150,12 +150,39 @@ def delta_program_kwargs(
     return kw
 
 
+class DeltaPlan:
+    """One increment's executable fast-path roster, planning separated
+    from execution (ISSUE 12): ``engines`` is the round-robin order —
+    the delta (B) program, the cross program when links grew, and the
+    BASE program last; ``bucketed`` records whether the delta programs
+    run shape-bucketed (the cohort precondition).  Built by
+    ``IncrementalClassifier._delta_fast_plan``; executed inline by
+    ``_execute_delta_plan`` or jointly for N same-roster tenants by
+    ``core/cohort.py``'s vmapped loop."""
+
+    __slots__ = ("engines", "base", "bucketed", "idx")
+
+    def __init__(self, engines, base, bucketed, idx):
+        self.engines = engines
+        self.base = base
+        self.bucketed = bool(bucketed)
+        self.idx = idx
+
+    def roster_key(self) -> tuple:
+        """Position-wise bucket signatures — two tenants may share one
+        cohort dispatch iff their roster keys are EQUAL (same program
+        at every round-robin position, so the vmapped joint loop runs
+        the identical vote sequence each tenant would run solo)."""
+        return tuple(e.bucket_signature for e in self.engines)
+
+
 def warm_delta_programs(
     config: ClassifierConfig,
     base_engine,
     idx,
     mesh=None,
     max_iters: Optional[int] = None,
+    cohort_sizes: Optional[List[int]] = None,
 ) -> List[dict]:
     """AOT the canonical steady-state delta-program buckets for a
     warmed base — the delta-plane half of the warmup precompile: after
@@ -176,7 +203,13 @@ def warm_delta_programs(
     Program content is irrelevant — bucketed programs are pure
     functions of their bucket signature — so synthetic one-row tables
     over the base corpus resolve to exactly the rungs live deltas
-    will request.  Returns one record per warmed roster."""
+    will request.  Returns one record per warmed roster.
+
+    ``cohort_sizes`` (None = ``config.cohort_warm_size_list()``): also
+    AOT the COHORT variants (``core/cohort.py`` — ``vmap`` of each
+    roster program plus the base program over the pow2 tenant ladder)
+    at these sizes, so a restarted replica's FIRST cohort dispatches
+    compile-free too."""
     import dataclasses
 
     from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
@@ -276,6 +309,7 @@ def warm_delta_programs(
             ("cross", idx, frozenset(cross_rules), (best, best + 1))
         )
     out = []
+    engines = []
     for name, eng_idx, rules, window in rosters:
         eng = RowPackedSaturationEngine(
             eng_idx,
@@ -287,6 +321,27 @@ def warm_delta_programs(
         rec["program"] = name
         rec["bucket_signature"] = eng.bucket_signature
         out.append(rec)
+        engines.append((name, eng))
+    if cohort_sizes is None:
+        cohort_sizes = config.cohort_warm_size_list()
+    if cohort_sizes and config.cohort_enable:
+        from distel_tpu.core.cohort import warm_cohort_programs
+
+        # cohort traffic requests the CANONICAL roster (the planner's
+        # cohort_shape normalization resolves every small delta to the
+        # delta[mixed] shape + cross + the base program — see
+        # IncrementalClassifier._canonical_delta_tables), so only those
+        # three positions need cohort variants warmed
+        warm_names = {"delta[mixed]", "cross"}
+        roster = [
+            (name, eng) for name, eng in engines if name in warm_names
+        ] + [("base", base_engine)]
+        for name, eng in roster:
+            for rec in warm_cohort_programs(
+                [eng], cohort_sizes, budget
+            ):
+                rec["program"] = f"cohort[{name}x{rec['rung']}]"
+                out.append(rec)
     return out
 
 
@@ -483,6 +538,17 @@ class IncrementalClassifier:
         path = "fast" if result is not None else "rebuild"
         if result is None:
             result = self._full_rebuild(idx)
+        return self._finish_increment(batch, result, path)
+
+    def _finish_increment(
+        self, batch, result: SaturationResult, path: str
+    ) -> SaturationResult:
+        """Commit one increment's result: retain the packed closure,
+        bump the increment counter, append the history record.  Split
+        out of :meth:`add_ontology` so the COHORT delta path
+        (``core/cohort.py`` — ingest and plan per tenant, execute N
+        tenants under one vmapped dispatch) can complete each member's
+        increment with byte-identical bookkeeping."""
         if result.transposed:
             # keep the closure packed AND device-resident: the next
             # increment's embed runs on device, so the closure never
@@ -501,7 +567,7 @@ class IncrementalClassifier:
                 # which saturation plane served the increment — the
                 # serve layer's fast-path-vs-rebuild ratio comes from
                 # here ("fast": base program reused; "rebuild": fresh
-                # compile)
+                # compile; "cohort": fast path via a cohort dispatch)
                 "path": path,
                 **(
                     self.last_compile.as_dict()
@@ -672,8 +738,109 @@ class IncrementalClassifier:
             return False
         return idx.n_concepts < base.nc and idx.n_links < base.nl
 
+    def _canonical_delta_tables(self, idx, b, delta_idx, links_grew):
+        """The canonical cohort roster's tables (ISSUE 12), or None
+        when this delta cannot take the canonical shape.
+
+        Canonical = the base-structure-determined union of the two
+        reference traffic shapes (class assertions + property
+        assertions, ``scripts/traffic-data-load-classify.sh``) —
+        exactly the ``delta[mixed]`` roster ``warm_delta_programs``
+        warms.  A member whose delta lacks a family rides an INERT
+        REPLAY row instead: re-deriving a base axiom's consequences
+        against a closure already containing them sets no new bit
+        (monotone + idempotent), so padding changes neither the fixed
+        point nor any vote's change signal — it only aligns the traced
+        program's table rungs so heterogeneous deltas share one
+        signature.  Returns ``(canon_idx, rules, link_window | None)``.
+        """
+        import dataclasses
+
+        from distel_tpu.core.indexing import TOP_ID
+
+        # only the canonical families can be padded; a delta carrying
+        # nf2/nf4 rows (or chain axioms over a chainless base, where no
+        # inert chain row exists for its peers) keeps its content shape
+        if len(delta_idx.nf2) or len(delta_idx.nf4):
+            return None
+        if len(delta_idx.chain_pairs) and not len(b.chain_pairs):
+            return None
+        tables = {}
+        rules = {"CR1"}
+        inert1 = (
+            np.asarray(b.nf1[:1])
+            if len(b.nf1)
+            else np.asarray([[TOP_ID, TOP_ID]], np.int64)
+        )
+        tables["nf1"] = (
+            np.asarray(delta_idx.nf1) if len(delta_idx.nf1) else inert1
+        )
+        if len(b.nf3):
+            rules.add("CR3")
+            tables["nf3"] = (
+                np.asarray(delta_idx.nf3)
+                if len(delta_idx.nf3)
+                else np.asarray(b.nf3[:1])
+            )
+            if len(b.chain_pairs):
+                rules.add("CR6")
+                tables["chain_pairs"] = (
+                    np.asarray(delta_idx.chain_pairs)
+                    if len(delta_idx.chain_pairs)
+                    else np.asarray(b.chain_pairs[:1])
+                )
+        elif len(delta_idx.nf3):
+            # link-creating delta over an nf3-less base: class-only
+            # peers would have no inert nf3 row to pad with
+            return None
+        if idx.has_bottom_axioms:
+            # uniform across link-creating and class-only members (the
+            # solo roster gates CR5 on links_grew; the extra sweep here
+            # is an idempotent re-derivation)
+            rules.add("CR5")
+        canon_idx = dataclasses.replace(
+            delta_idx,  # nf2/nf4 stay the (guarded) empty delta tables
+            nf1=tables["nf1"],
+            nf3=tables.get("nf3", delta_idx.nf3),
+            chain_pairs=tables.get(
+                "chain_pairs", delta_idx.chain_pairs
+            ),
+        )
+        # the cross program joins the FULL nf4/chain tables against a
+        # link window: the delta's new links when they exist, else ONE
+        # existing base link (inert replay) so class-only members share
+        # the cross position too.  Window bounds are runtime arguments
+        # in bucket mode, so every member requests the same program.
+        window = None
+        if len(idx.nf4) or len(idx.chain_pairs):
+            if links_grew:
+                window = (b.n_links, idx.n_links)
+            elif b.n_links:
+                window = (b.n_links - 1, b.n_links)
+        return canon_idx, rules, window
+
     def _delta_fast_path(self, idx) -> Optional[SaturationResult]:
-        """Reuse the base corpus's compiled program for a delta — the
+        """Plan + execute the delta fast path (None = take the rebuild
+        path).  The planning half (:meth:`_delta_fast_plan`) builds the
+        engine roster; the execution half (:meth:`_execute_delta_plan`)
+        runs the round-robin joint fixed point inline — the cohort path
+        (``core/cohort.py``) reuses the SAME planner per tenant and
+        replaces only the executor with one vmapped joint loop, which
+        is what makes cohort results byte-identical to solo ones."""
+        plan = self._delta_fast_plan(idx)
+        if plan is None:
+            return None
+        return self._execute_delta_plan(plan)
+
+    def _delta_fast_plan(
+        self, idx, *, cohort_shape: bool = False
+    ) -> Optional["DeltaPlan"]:
+        """Eligibility guards + engine roster of the delta fast path —
+        everything up to (but not including) device execution.  May
+        mutate the base engine (the masks-only closure rebind), so a
+        returned plan must be EXECUTED, not discarded.
+
+        Reuse of the base corpus's compiled program is the
         amortization the reference gets from its increments being plain
         Redis inserts (``init/AxiomLoader.java:119-129``).
 
@@ -721,7 +888,6 @@ class IncrementalClassifier:
             return None
         import dataclasses
 
-        from distel_tpu.core.engine import _host_bit_total, fetch_global
         from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
 
         links_grew = idx.n_links > b.n_links
@@ -842,28 +1008,63 @@ class IncrementalClassifier:
         shape_kw = delta_program_kwargs(
             self.config, base, mesh=self._mesh, bucket=bucket_delta
         )
+        # ``cohort_shape``: normalize the roster to the CANONICAL cohort
+        # shape (ISSUE 12) — rule set and table presence determined by
+        # the BASE structure, missing families padded with inert replay
+        # rows — so heterogeneous same-bucket deltas (class-only, link,
+        # mixed) resolve to ONE roster key and share a cohort dispatch.
+        # Falls back to the content roster (still cohortable among
+        # identical shapes) when the delta carries families canonical
+        # padding cannot cover.
+        canon = None
+        if cohort_shape and bucket_delta:
+            canon = self._canonical_delta_tables(
+                idx, b, delta_idx, links_grew
+            )
         engines = []
-        if rules:
+        if canon is not None:
+            canon_idx, canon_rules, window = canon
             engines.append(
                 RowPackedSaturationEngine(
-                    delta_idx, rules=frozenset(rules), **shape_kw
+                    canon_idx, rules=frozenset(canon_rules), **shape_kw
                 )
             )
-        if links_grew:
-            cross_rules = set()
-            if len(idx.nf4):
-                cross_rules.add("CR4")
-            if len(idx.chain_pairs):
-                cross_rules.add("CR6")
-            if cross_rules:
+            if window is not None:
+                cross_rules = set()
+                if len(idx.nf4):
+                    cross_rules.add("CR4")
+                if len(idx.chain_pairs):
+                    cross_rules.add("CR6")
                 engines.append(
                     RowPackedSaturationEngine(
-                        idx,  # FULL tables × the new-link window only
+                        idx,  # FULL tables × the (possibly inert) window
                         rules=frozenset(cross_rules),
-                        link_window=(b.n_links, idx.n_links),
+                        link_window=window,
                         **shape_kw,
                     )
                 )
+        else:
+            if rules:
+                engines.append(
+                    RowPackedSaturationEngine(
+                        delta_idx, rules=frozenset(rules), **shape_kw
+                    )
+                )
+            if links_grew:
+                cross_rules = set()
+                if len(idx.nf4):
+                    cross_rules.add("CR4")
+                if len(idx.chain_pairs):
+                    cross_rules.add("CR6")
+                if cross_rules:
+                    engines.append(
+                        RowPackedSaturationEngine(
+                            idx,  # FULL tables × the new-link window only
+                            rules=frozenset(cross_rules),
+                            link_window=(b.n_links, idx.n_links),
+                            **shape_kw,
+                        )
+                    )
         if not engines and not closure_changed:
             return None  # nothing new for the engines: rebuild path
         # (a pure r ⊑ s delta may carry NO new table rows: the rebound
@@ -884,6 +1085,19 @@ class IncrementalClassifier:
                 b, role_closure=np.asarray(clo_new)
             )
         engines.append(base)
+        return DeltaPlan(
+            engines=engines, base=base, bucketed=bucket_delta, idx=idx
+        )
+
+    def _execute_delta_plan(self, plan: "DeltaPlan") -> SaturationResult:
+        """Inline (single-tenant) execution of a fast-path plan: the
+        round-robin joint fixed point over the delta/cross programs and
+        the base program — one device dispatch per vote per tenant, the
+        N-dispatch baseline the cohort path collapses to 1."""
+        from distel_tpu.core.engine import _host_bit_total, fetch_global
+
+        engines, base = plan.engines, plan.base
+        bucket_delta = plan.bucketed
         self.last_result = None
         # a one-slot box keeps this frame from pinning any state tuple
         # through a saturate call (a held reference would add a full
@@ -950,7 +1164,7 @@ class IncrementalClassifier:
             packed_r=box[0][1],
             iterations=iters,
             derivations=final_total - start_total,
-            idx=idx,
+            idx=plan.idx,
             converged=True,
             transposed=True,
         )

@@ -90,6 +90,7 @@ from distel_tpu.ops.bitpack import (
     bit_lookup_from,
 )
 from distel_tpu.runtime.instrumentation import (
+    COHORT_EVENTS,
     FRONTIER_EVENTS,
     CompileStats,
     FrontierStats,
@@ -4079,7 +4080,11 @@ class RowPackedSaturationEngine:
         if self.mesh is None:
             # AOT path: the compiled executable comes from the program
             # registry (bucket mode) or this engine's per-budget cache —
-            # either way the build cost lands in compile_stats
+            # either way the build cost lands in compile_stats.  The
+            # dispatch lands in the process-global solo-vs-cohort tally
+            # (the cohort path's N→1 dispatch collapse is asserted
+            # against these counters, see core/cohort.py).
+            COHORT_EVENTS.record_solo()
             out = self._run_aot(budget)(sp0, rp0, self._masks)
         else:
             out = self._run_jit(budget)(sp0, rp0, self._masks)

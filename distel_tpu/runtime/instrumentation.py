@@ -266,6 +266,66 @@ class FrontierAggregate:
 FRONTIER_EVENTS = FrontierAggregate()
 
 
+class CohortAggregate:
+    """Process-global tally of saturation-run DEVICE DISPATCHES, split
+    solo vs cohort — the instrumentation the cohort execution path's
+    acceptance rests on (ISSUE 12): "device dispatches per steady delta
+    drop from N (one per tenant) to 1 per cohort" must be *counted*,
+    not inferred from wall clocks.  ``record_solo`` fires once per
+    single-tenant fixed-point dispatch
+    (``RowPackedSaturationEngine.saturate``); ``record_cohort`` once
+    per vmapped cohort dispatch, carrying how many live tenants the one
+    launch advanced.  The serve plane samples :data:`COHORT_EVENTS`
+    into the ``distel_cohort_*`` gauges; tests snapshot before/after
+    deltas.  Thread-safe: scheduler workers dispatch concurrently."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        #: single-tenant fixed-point run dispatches (one per saturate)
+        self.solo_dispatches = 0
+        #: vmapped cohort run dispatches (one per joint vote)
+        self.cohort_dispatches = 0
+        #: tenants advanced summed over cohort dispatches (÷ dispatches
+        #: = the measured effective batch per device launch)
+        self.cohort_tenant_votes = 0
+        #: cohort deltas completed (one per member increment)
+        self.cohort_deltas = 0
+        #: live tenant count / padded pow2 rung of the last cohort
+        self.last_size = 0
+        self.last_rung = 0
+
+    def record_solo(self) -> None:
+        with self._lock:
+            self.solo_dispatches += 1
+
+    def record_cohort(self, size: int, rung: int) -> None:
+        with self._lock:
+            self.cohort_dispatches += 1
+            self.cohort_tenant_votes += size
+            self.last_size = size
+            self.last_rung = rung
+
+    def record_deltas(self, n: int) -> None:
+        with self._lock:
+            self.cohort_deltas += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "solo_dispatches": self.solo_dispatches,
+                "cohort_dispatches": self.cohort_dispatches,
+                "cohort_tenant_votes": self.cohort_tenant_votes,
+                "cohort_deltas": self.cohort_deltas,
+                "last_size": self.last_size,
+                "last_rung": self.last_rung,
+            }
+
+
+COHORT_EVENTS = CohortAggregate()
+
+
 class _PersistentCacheCounter:
     """Process-global tally of jax's persistent-compilation-cache events
     (``/jax/compilation_cache/cache_hits`` / ``cache_misses``).  jax's

@@ -316,15 +316,162 @@ class OntologyRegistry:
             # ontology than the one the closure answers for
             onto = owl_loader.load(text)
             entry.texts.append(text)
-            result = inc.add_ontology(onto)
-            entry.resident_bytes = _state_bytes(inc)
-            entry.last_used = time.monotonic()
-            version = self._publish(oid, inc)
+            inc.add_ontology(onto)
+            rec = self._commit_delta(oid, entry, inc, len(texts))
         self.traffic.note_write(oid)
         self._note_path(inc)
         self._maybe_evict(keep=oid)
+        return rec
+
+    def cohort_key(self, oid: str) -> Optional[str]:
+        """Cohort-formation grouping proxy (ISSUE 12): the ontology's
+        compiled BASE program's bucket signature, or None when it has
+        no cohortable posture (unknown, not resident, no base program,
+        mesh or exact-shape engine).  Deliberately LOCK-FREE and racy —
+        the scheduler calls it while holding its own condition
+        variable, and execution re-validates every member; a stale
+        answer only costs a fallback, never correctness."""
+        with self._lock:
+            entry = self._entries.get(oid)
+        if entry is None:
+            return None
+        inc = entry.inc  # unlocked read: grouping hint only
+        if inc is None:
+            return None
+        base = inc._base_engine
+        if (
+            base is None
+            or base.mesh is not None
+            or not getattr(base, "_bucket", False)
+        ):
+            return None
+        return base.bucket_signature
+
+    def delta_cohort(self, items: List) -> Dict[str, object]:
+        """Apply one delta increment per ontology, advancing every
+        cohort-compatible member under shared vmapped dispatches
+        (``core/cohort.py``) — one device launch per joint vote instead
+        of one per tenant.  ``items``: ``(oid, texts)`` pairs, each
+        member one increment (the scheduler's per-lane coalescing
+        already merged its texts).  Returns ``{oid: record |
+        BaseException}`` — per-member failures (parse errors, unknown
+        ids) never poison the cohort, and members whose plans cannot
+        share a roster fall back to inline execution with the same
+        records a solo :meth:`delta` would produce.
+
+        Locking: every member's entry lock is acquired in SORTED oid
+        order (two concurrent cohorts can never deadlock), and
+        eviction is deferred to the end, outside the locks — the solo
+        path's promote-time eviction could otherwise pick a co-held
+        member as its victim (RLock re-acquisition by this thread
+        succeeds) and demote a classifier mid-cohort."""
+        from distel_tpu.core import cohort as cohort_mod
+        from distel_tpu.owl import loader as owl_loader
+
+        out: Dict[str, object] = {}
+        entries = []
+        for oid, texts in items:
+            try:
+                entries.append((oid, list(texts), self._entry(oid)))
+            except UnknownOntology as e:
+                out[oid] = e
+        entries.sort(key=lambda t: t[0])
+        acquired = []
+        committed = []  # (oid, entry, inc) — publish/record done inside
+        try:
+            for _oid, _texts, entry in entries:
+                entry.lock.acquire()
+                acquired.append(entry)
+            planned = []  # (oid, entry, inc, plan, batch, idx, n_texts)
+            solo = []
+            for oid, texts, entry in entries:
+                try:
+                    self._check_live(entry)
+                    inc = self._resident(entry, evict=False)
+                    text = "\n".join(texts)
+                    # parse FIRST, record the text BEFORE saturating —
+                    # same ingestion contract as the solo delta path
+                    onto = owl_loader.load(text)
+                    entry.texts.append(text)
+                    inc.last_compile = None
+                    inc.last_delta_stats = None
+                    idx, batch = inc._ingest(onto)
+                    plan = inc._delta_fast_plan(idx, cohort_shape=True)
+                    rec = (oid, entry, inc, plan, batch, idx, len(texts))
+                    if plan is not None and cohort_mod.delta_cohort_ready(
+                        inc, plan
+                    ):
+                        planned.append(rec)
+                    else:
+                        solo.append(rec)
+                except BaseException as e:  # noqa: BLE001 — per-member
+                    out[oid] = e
+            groups: Dict[tuple, List] = {}
+            for rec in planned:
+                groups.setdefault(rec[3].roster_key(), []).append(rec)
+            for grp in groups.values():
+                if len(grp) < 2:
+                    solo.extend(grp)
+                    continue
+                try:
+                    cohort_mod.execute_delta_cohort(
+                        [(inc, plan, batch)
+                         for (_o, _e, inc, plan, batch, _i, _n) in grp]
+                    )
+                    self._count("distel_cohort_formed_total")
+                    for oid, entry, inc, _plan, _batch, _idx, n in grp:
+                        out[oid] = self._commit_delta(
+                            oid, entry, inc, n
+                        )
+                        committed.append((oid, entry, inc))
+                except BaseException as e:  # noqa: BLE001
+                    # a failed joint dispatch leaves each member's
+                    # axioms ingested but its packed state consumed:
+                    # the classifiers re-derive from scratch on their
+                    # next increment (monotone saturation from the
+                    # fresh init is sound, just cold) — report the
+                    # error to every member
+                    for oid, _e2, _i2, _p2, _b2, _i3, _n2 in grp:
+                        out[oid] = e
+            for oid, entry, inc, plan, batch, idx, n in solo:
+                try:
+                    self._count("distel_cohort_fallback_total")
+                    if plan is not None:
+                        res = inc._execute_delta_plan(plan)
+                        inc._finish_increment(batch, res, "fast")
+                    else:
+                        res = inc._full_rebuild(idx)
+                        inc._finish_increment(batch, res, "rebuild")
+                    out[oid] = self._commit_delta(
+                        oid, entry, inc, n
+                    )
+                    committed.append((oid, entry, inc))
+                except BaseException as e:  # noqa: BLE001
+                    out[oid] = e
+        finally:
+            for entry in reversed(acquired):
+                entry.lock.release()
+        for oid, _entry, inc in committed:
+            self.traffic.note_write(oid)
+            self._note_path(inc)
+        self._maybe_evict()
+        return out
+
+    def _commit_delta(self, oid, entry, inc, n_texts) -> dict:
+        """Post-increment bookkeeping shared by the solo :meth:`delta`
+        and every cohort member: byte accounting, snapshot publish, and
+        the response record — ONE implementation so cohort-served and
+        solo-served deltas can never drift apart in what they commit or
+        report.  Caller holds ``entry.lock``."""
+        entry.resident_bytes = _state_bytes(inc)
+        entry.last_used = time.monotonic()
+        version = self._publish(oid, inc)
         rec = dict(inc.history[-1])
-        rec.update(id=oid, batched=len(texts), concepts=result.idx.n_concepts)
+        rec.update(
+            id=oid,
+            batched=n_texts,
+            concepts=inc.last_result.idx.n_concepts,
+        )
         if version is not None:
             rec["version"] = version
         return rec
@@ -462,11 +609,31 @@ class OntologyRegistry:
     def _publish(self, oid: str, inc) -> Optional[int]:
         """Publish the committed closure as a versioned read snapshot
         (swap-on-commit).  Caller holds ``entry.lock`` — a publish must
-        never interleave with an export's unpublish-and-deregister."""
+        never interleave with an export's unpublish-and-deregister.
+
+        No-op commits skip the rebuild (ISSUE 12 satellite): when the
+        increment derived nothing new AND grew no concepts, the packed
+        closure is bit-identical to the published snapshot's, so the
+        O(closure) device→host fetch + snapshot build would produce
+        the same bytes — the live snapshot is reused as-is (its
+        version answers the caller's read-your-writes watermark, which
+        an unchanged closure satisfies by construction)."""
         if self.query is None or inc.last_result is None:
             return None
+        res = inc.last_result
+        if res.derivations == 0:
+            try:
+                snap = self.query.get(oid)
+            except KeyError:
+                snap = None
+            if (
+                snap is not None
+                and snap.n_concepts == res.idx.n_concepts
+            ):
+                self._count("distel_query_republish_skipped_total")
+                return snap.version
         snap = self.query.publish_result(
-            oid, inc.last_result, at_least=inc.increment
+            oid, res, at_least=inc.increment
         )
         return snap.version
 
@@ -488,11 +655,14 @@ class OntologyRegistry:
             pass
         return self._publish(oid, inc)
 
-    def _resident(self, entry: _Entry):
+    def _resident(self, entry: _Entry, evict: bool = True):
         """Entry's classifier, promoted from the warm tier (host-RAM
         packed state, no frontend replay) or restored from the cold
         spill (checksum-verified, full text replay).  Caller holds
-        ``entry.lock``."""
+        ``entry.lock``.  ``evict=False`` defers the promote-time
+        budget sweep to the caller — the cohort path holds SEVERAL
+        entry locks at once, and this thread's own RLocks re-acquire,
+        so an inline eviction could demote a co-held member."""
         if entry.inc is not None:
             return entry.inc
         t0 = time.monotonic()
@@ -521,7 +691,8 @@ class OntologyRegistry:
                 )
             self._note_compile(inc.last_compile)
             self._publish_if_missing(entry.oid, inc)
-            self._maybe_evict(keep=entry.oid)
+            if evict:
+                self._maybe_evict(keep=entry.oid)
             return inc
         from distel_tpu.core.incremental import IncrementalClassifier
 
@@ -552,7 +723,8 @@ class OntologyRegistry:
         # with compile ≈ 0 (the whole point of the warmup precompile)
         self._note_compile(inc.last_compile)
         self._publish_if_missing(entry.oid, inc)
-        self._maybe_evict(keep=entry.oid)
+        if evict:
+            self._maybe_evict(keep=entry.oid)
         return inc
 
     def _verify_spill(self, entry: _Entry) -> None:
@@ -860,9 +1032,20 @@ class OntologyRegistry:
                     "delta.program_cache_hit",
                     bool(rec.get("program_cache_hit")),
                 )
+        if span is not None and rec.get("cohort_size"):
+            span.set_attr("cohort.size", rec["cohort_size"])
+            span.set_attr(
+                "cohort.dispatches", rec.get("cohort_dispatches", 0)
+            )
         if self.metrics is None:
             return
-        if path == "fast":
+        if path in ("fast", "cohort"):
+            if path == "cohort":
+                # the cohort path IS the fast path (base program
+                # reused, bucketed delta programs) executed jointly —
+                # both counters move so the fast-path ratio dashboards
+                # keep reading correctly
+                self._count("distel_cohort_deltas_total")
             self._count("distel_deltas_fast_path_total")
             n = rec.get("delta_programs", 0)
             if n:

@@ -12,6 +12,14 @@ whole stack):
   lane coalesce into ONE executor call (one saturation for k queued
   deltas — the tensor analog of the reference absorbing a burst of
   Redis inserts into one increment);
+* **cohort formation** (ISSUE 12) — pending batchable deltas on
+  DISTINCT lanes whose ontologies share a bucket signature
+  (``cohort_key``) are grouped under a bounded wait
+  (``cohort.max_size`` / ``cohort.max_wait_ms``) into one
+  ``execute_cohort`` call: the registry advances the whole cohort
+  with one vmapped device dispatch per vote instead of one dispatch
+  per tenant.  Per-ontology serialization is preserved — every member
+  is the head of its lane and all member lanes go active together;
 * **admission control** — a full queue rejects at submit
   (:class:`QueueFull` → HTTP 429 + Retry-After) instead of queueing
   unboundedly;
@@ -107,10 +115,26 @@ class RequestScheduler:
         max_batch: int = 8,
         metrics=None,
         tracer=None,
+        cohort_key: Optional[Callable[[str], Optional[str]]] = None,
+        execute_cohort: Optional[Callable[[List], Dict]] = None,
+        cohort_max_size: int = 8,
+        cohort_max_wait_s: float = 0.025,
     ):
         if workers < 1 or max_queue < 1 or max_batch < 1:
             raise ValueError("workers, max_queue, max_batch must be >= 1")
         self._execute = execute
+        #: cohort-formation lane (both callbacks required to engage):
+        #: ``cohort_key(key) -> signature | None`` is the CHEAP
+        #: non-blocking grouping proxy (the registry answers with the
+        #: ontology's base bucket signature); ``execute_cohort(members)
+        #: -> {key: record | BaseException}`` advances every member —
+        #: members are ``(key, payloads)`` pairs, one increment each
+        self._cohort_key = (
+            self._safe_key_fn(cohort_key) if cohort_key else None
+        )
+        self._execute_cohort = execute_cohort
+        self.cohort_max_size = max(int(cohort_max_size), 1)
+        self.cohort_max_wait_s = max(float(cohort_max_wait_s), 0.0)
         self.max_queue = max_queue
         self.max_batch = max_batch
         self.metrics = metrics
@@ -118,6 +142,13 @@ class RequestScheduler:
         #: and lane-exec spans for requests that carried a trace context
         self.tracer = tracer
         self._cv = threading.Condition()
+        #: cohort rendezvous: signature → the forming worker's member
+        #: list.  A second worker that pops a same-signature delta
+        #: while one is forming DONATES its batch into the list (and
+        #: the forming worker resolves those requests) instead of
+        #: executing solo — without this, N workers racing N tenants'
+        #: deltas would each claim one lane and never see the others.
+        self._forming: Dict[str, List] = {}
         #: key → FIFO of queued requests (admission order per lane)
         self._lanes: Dict[str, collections.deque] = {}
         #: lane admission order across keys (approximate global FIFO)
@@ -178,7 +209,13 @@ class RequestScheduler:
             if key not in self._order:
                 self._order.append(key)
             self._depth += 1
-            self._cv.notify()
+            # notify_all, not notify: since the cohort-formation lane,
+            # cv waiters are no longer fungible — a forming leader can
+            # consume a single wakeup it cannot act on (a query, a
+            # non-matching delta) while an idle worker sleeps on, and
+            # the request would then stall until the leader's bounded
+            # wait expires
+            self._cv.notify_all()
         return req
 
     # ----------------------------------------------------------- worker
@@ -191,6 +228,41 @@ class RequestScheduler:
                 return key
         return None
 
+    @staticmethod
+    def _safe_key_fn(fn):
+        """A cohort_key that throws must degrade that request to solo
+        execution, never kill the worker thread."""
+
+        def safe(key):
+            try:
+                return fn(key)
+            except Exception:  # noqa: BLE001 — grouping hint only
+                return None
+
+        return safe
+
+    def _pop_batch(self, key: str) -> List[Request]:
+        """Pop the lane head plus contiguous batchable same-kind
+        requests.  Caller holds ``self._cv``."""
+        lane = self._lanes[key]
+        batch = [lane.popleft()]
+        while (
+            lane
+            and len(batch) < self.max_batch
+            and batch[0].batchable
+            and lane[0].batchable
+            and lane[0].kind == batch[0].kind
+        ):
+            batch.append(lane.popleft())
+        self._depth -= len(batch)
+        if not lane:
+            self._lanes.pop(key, None)
+            try:
+                self._order.remove(key)
+            except ValueError:
+                pass
+        return batch
+
     def _worker_loop(self) -> None:
         while True:
             with self._cv:
@@ -200,31 +272,183 @@ class RequestScheduler:
                         return
                     self._cv.wait()
                     key = self._pick()
-                lane = self._lanes[key]
-                batch = [lane.popleft()]
-                # coalesce contiguous batchable requests of the same kind
-                while (
-                    lane
-                    and len(batch) < self.max_batch
-                    and batch[0].batchable
-                    and lane[0].batchable
-                    and lane[0].kind == batch[0].kind
-                ):
-                    batch.append(lane.popleft())
-                self._depth -= len(batch)
-                if not lane:
-                    self._lanes.pop(key, None)
-                    try:
-                        self._order.remove(key)
-                    except ValueError:
-                        pass
+                batch = self._pop_batch(key)
                 self._active.add(key)
+                members = [(key, batch)]
+                donated = False
+                if (
+                    self._execute_cohort is not None
+                    and self._cohort_key is not None
+                    and self.cohort_max_size >= 2
+                    and batch[0].batchable
+                    and batch[0].kind == "delta"
+                ):
+                    sig = self._cohort_key(key)
+                    forming = (
+                        self._forming.get(sig) if sig is not None else None
+                    )
+                    if (
+                        forming is not None
+                        and len(forming) < self.cohort_max_size
+                    ):
+                        # another worker is forming this signature's
+                        # cohort: donate our batch (it resolves the
+                        # requests and releases the key) and move on
+                        forming.append((key, batch))
+                        self._cv.notify_all()
+                        donated = True
+                    elif sig is not None:
+                        self._forming[sig] = members
+                        try:
+                            self._gather_cohort(sig, members)
+                        finally:
+                            self._forming.pop(sig, None)
+            if donated:
+                continue
             try:
-                self._run_batch(key, batch)
+                if len(members) == 1:
+                    self._run_batch(key, batch)
+                else:
+                    self._run_cohort(members)
             finally:
                 with self._cv:
-                    self._active.discard(key)
+                    for k, _b in members:
+                        self._active.discard(k)
                     self._cv.notify_all()
+
+    def _gather_cohort(self, sig: str, members: List) -> None:
+        """Cohort-formation lane; mutates ``members`` in place.
+        Caller holds ``self._cv``.
+        Two intake paths run concurrently until
+        ``cohort_max_size`` members or the bounded wait expires: this
+        worker scans idle lanes for pending batchable deltas whose
+        ontology shares the leader's cohort signature (claiming each —
+        the lane goes active, so per-ontology serialization holds), and
+        OTHER workers donate same-signature batches they popped through
+        the ``_forming`` rendezvous.  The wait releases the lock
+        (``cv.wait``), so workers and submissions proceed; every
+        submit/donation notifies, so a late-arriving companion is
+        claimed the moment it appears."""
+        lead_batch = members[0][1]
+        deadline = time.monotonic() + self.cohort_max_wait_s
+        while True:
+            taken = {k for k, _b in members}
+            for k2 in list(self._order):
+                if len(members) >= self.cohort_max_size:
+                    break
+                if k2 in self._active or k2 in taken:
+                    continue
+                lane = self._lanes.get(k2)
+                if not lane:
+                    continue
+                head = lane[0]
+                if not (
+                    head.batchable and head.kind == lead_batch[0].kind
+                ):
+                    continue
+                if self._cohort_key(k2) != sig:
+                    continue
+                b2 = self._pop_batch(k2)
+                self._active.add(k2)
+                members.append((k2, b2))
+            if len(members) >= self.cohort_max_size or self._stopping:
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            self._cv.wait(remaining)
+
+    def _run_cohort(self, members: List) -> None:
+        """Execute one formed cohort: expire stale requests, hand every
+        live member's payloads to ``execute_cohort`` in ONE call, and
+        resolve each member's requests from the per-key outcome map
+        (``BaseException`` values fail that member alone — a parse
+        error in one tenant's delta must not poison its cohort)."""
+        now = time.monotonic()
+        live: List = []
+        for key, batch in members:
+            lv = []
+            for req in batch:
+                if req.deadline is not None and now > req.deadline:
+                    if self.metrics is not None:
+                        self.metrics.counter_inc(
+                            "distel_deadline_expired_total"
+                        )
+                    req._fail(Deadline("deadline passed while queued"))
+                else:
+                    lv.append(req)
+            if lv:
+                live.append((key, lv))
+        if not live:
+            return
+        if len(live) == 1:
+            # every companion expired while queued — plain lane batch
+            # (re-runs the deadline filter, a no-op for survivors)
+            self._run_batch(live[0][0], live[0][1])
+            return
+        kind = live[0][1][0].kind
+        if self.metrics is not None:
+            self.metrics.observe(
+                "distel_cohort_size",
+                len(live),
+                buckets=(1, 2, 4, 8, 16),
+            )
+            self.metrics.observe(
+                "distel_queue_wait_seconds",
+                now - min(r.enqueued for _k, lv in live for r in lv),
+                {"kind": kind},
+            )
+        lead_ctx = None
+        if self.tracer is not None:
+            wall = time.time()
+            for key, lv in live:
+                for req in lv:
+                    if req.ctx is not None:
+                        if lead_ctx is None and req.ctx.sampled:
+                            lead_ctx = req.ctx
+                        self.tracer.record_complete(
+                            "scheduler.queue", req.ctx, req.enqueued_wall,
+                            wall, {"kind": req.kind, "key": key},
+                        )
+        span_cm = (
+            self.tracer.span(
+                "scheduler.cohort",
+                parent=lead_ctx,
+                attrs={
+                    "kind": kind,
+                    "cohort.size": len(live),
+                    "keys": ",".join(k for k, _lv in live)[:200],
+                },
+            )
+            if lead_ctx is not None
+            else contextlib.nullcontext(_obs_trace.NOOP)
+        )
+        with span_cm as lane:
+            try:
+                results = self._execute_cohort(
+                    [(k, [r.payload for r in lv]) for k, lv in live]
+                )
+            except BaseException as e:  # noqa: BLE001 — relayed to waiters
+                lane.set_status("error")
+                lane.set_attr("error", f"{type(e).__name__}: {e}"[:200])
+                for _k, lv in live:
+                    for req in lv:
+                        req._fail(e)
+                return
+        for key, lv in live:
+            out = results.get(key) if results else None
+            for req in lv:
+                req.batched = len(lv)
+                if isinstance(out, BaseException):
+                    req._fail(out)
+                elif out is None:
+                    req._fail(
+                        RuntimeError(
+                            f"cohort executor returned nothing for {key!r}"
+                        )
+                    )
+                else:
+                    req._resolve(out)
 
     def _run_batch(self, key: str, batch: List[Request]) -> None:
         now = time.monotonic()

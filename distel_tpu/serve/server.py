@@ -240,6 +240,10 @@ class ServeApp:
             warm_budget_bytes=warm_budget_bytes,
             query=self.query,
         )
+        # ---- cohort-formation lane (ISSUE 12): pending deltas on
+        # distinct lanes group by base bucket signature under a bounded
+        # wait and advance under ONE vmapped device dispatch per vote
+        cohort_on = self.config.cohort_enable and self.config.cohort_max_size >= 2
         self.scheduler = RequestScheduler(
             self._execute,
             workers=workers,
@@ -247,6 +251,10 @@ class ServeApp:
             max_batch=max_batch,
             metrics=self.metrics,
             tracer=self.tracer,
+            cohort_key=self.registry.cohort_key if cohort_on else None,
+            execute_cohort=self._execute_cohort if cohort_on else None,
+            cohort_max_size=self.config.cohort_max_size,
+            cohort_max_wait_s=self.config.cohort_max_wait_ms / 1e3,
         )
         self.started = time.time()
         self._closed = False
@@ -318,6 +326,11 @@ class ServeApp:
             "per-commit snapshot build+swap wall",
         )
         self.metrics.describe(
+            "distel_query_republish_skipped_total",
+            "no-op commits (zero derivations, no new concepts) that "
+            "reused the published snapshot instead of rebuilding it",
+        )
+        self.metrics.describe(
             "distel_registry_promote_seconds",
             "warm-to-hot promotion wall (no frontend replay)",
         )
@@ -366,6 +379,59 @@ class ServeApp:
             for metric, _, help_text in _QUERY_GAUGES:
                 self.metrics.describe(metric, help_text)
             self.metrics.gauge_group(_query_gauges)
+        # ---- cohort execution plane (ISSUE 12): formation + dispatch
+        # telemetry — the N→1 dispatch-collapse dashboards
+        self.metrics.describe(
+            "distel_cohort_size",
+            "live tenants per formed cohort (scheduler formation lane)",
+        )
+        self.metrics.describe(
+            "distel_cohort_deltas_total",
+            "delta increments served via a cohort dispatch",
+        )
+        self.metrics.describe(
+            "distel_cohort_formed_total",
+            "cohorts executed (>= 2 members sharing one roster)",
+        )
+        self.metrics.describe(
+            "distel_cohort_fallback_total",
+            "cohort-lane members that executed inline (no roster "
+            "partner, non-bucketed plan, or rebuild path)",
+        )
+        from distel_tpu.runtime.instrumentation import COHORT_EVENTS
+
+        _COHORT_GAUGES = (
+            (
+                "distel_cohort_dispatches",
+                "cohort_dispatches",
+                "vmapped cohort run dispatches (one per joint vote)",
+            ),
+            (
+                "distel_cohort_tenant_votes",
+                "cohort_tenant_votes",
+                "live tenants advanced summed over cohort dispatches "
+                "(÷ dispatches = effective batch per device launch)",
+            ),
+            (
+                "distel_cohort_solo_dispatches",
+                "solo_dispatches",
+                "single-tenant fixed-point run dispatches (the "
+                "baseline the cohort collapse is measured against)",
+            ),
+            (
+                "distel_cohort_last_size",
+                "last_size",
+                "live tenant count of the last cohort dispatch",
+            ),
+        )
+
+        def _cohort_gauges():
+            snap = COHORT_EVENTS.snapshot()
+            return {m: snap[k] for m, k, _ in _COHORT_GAUGES}
+
+        for metric, _, help_text in _COHORT_GAUGES:
+            self.metrics.describe(metric, help_text)
+        self.metrics.gauge_group(_cohort_gauges)
         # ---- adaptive sparse-tail frontier telemetry: live-sampled
         # from the process-global controller aggregate
         # (runtime/instrumentation.FRONTIER_EVENTS) — per-round tier
@@ -525,6 +591,18 @@ class ServeApp:
                 with timer.phase("query"):
                     return self._taxonomy(key)
             raise ValueError(f"unknown request kind {kind!r}")
+        finally:
+            self.phases.absorb(timer)
+
+    def _execute_cohort(self, members):
+        """Cohort executor behind the scheduler's formation lane:
+        ``members`` are ``(oid, payloads)`` pairs; returns the per-oid
+        outcome map (records or exceptions) from the registry's joint
+        dispatch."""
+        timer = PhaseTimer()
+        try:
+            with timer.phase("delta"):
+                return self.registry.delta_cohort(members)
         finally:
             self.phases.absorb(timer)
 

@@ -1,0 +1,459 @@
+"""Cross-tenant cohort execution (ISSUE 12): one vmapped device
+dispatch advances N same-bucket tenants.
+
+The claims under test, in order of load-bearing-ness:
+
+* **Parity** — for cohorts of size 2/4/8 mixing class-only, link, and
+  mixed deltas across DIFFERENT same-bucket ontologies, every tenant's
+  closure is byte-identical to its solo (inline) execution, including
+  tenants that converge at different rounds (jax's while_loop batching
+  select is the live-tenant mask: converged members ride as no-ops
+  until the cohort drains).
+* **Dispatch collapse** — device run dispatches per steady delta drop
+  from N (one per tenant) to 1 per cohort vote, asserted against the
+  process-global ``COHORT_EVENTS`` tally, never inferred.
+* **Compile-free steady state** — cohort programs are registry hits on
+  the second same-shape cohort (``compile_s == 0.0``), and
+  ``warm_delta_programs``' cohort roster covers even the FIRST one.
+* **Formation** — the scheduler's cohort lane groups pending batchable
+  deltas by signature under the bounded wait, respecting max size and
+  per-ontology serialization (pure-callback unit tests, no jax).
+* Satellites: the warmup-roster drift guard (zero fixed-point program
+  builds after warmup for each canonical delta kind) and the no-op
+  commit snapshot-republish skip.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.core import cohort
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.core.program_cache import PROGRAMS
+from distel_tpu.owl import loader as owl_loader
+from distel_tpu.runtime.instrumentation import COHORT_EVENTS
+
+
+def _mk_base(p):
+    """One small per-tenant base, identical SHAPE across prefixes (one
+    bucket) with chains so CR3/CR4/CR6 structure exists."""
+    return (
+        f"SubClassOf({p}A {p}B)\nSubClassOf({p}B {p}C)\n"
+        f"SubClassOf({p}C ObjectSomeValuesFrom(r {p}D))\n"
+        f"SubClassOf(ObjectSomeValuesFrom(r {p}D) {p}E)\n"
+        f"SubClassOf({p}E {p}F)\n"
+        f"SubObjectPropertyOf(ObjectPropertyChain(r r) r)\n"
+    )
+
+
+def _mk_delta(p, kind, depth=1):
+    """Deltas by kind; ``depth`` controls convergence rounds so cohort
+    members genuinely diverge."""
+    if kind == "class":
+        lines = [f"SubClassOf({p}N0 {p}A)"] + [
+            f"SubClassOf({p}N{i} {p}N{i - 1})" for i in range(1, depth)
+        ]
+        return "\n".join(lines) + "\n"
+    if kind == "link":
+        return f"SubClassOf({p}L ObjectSomeValuesFrom(r {p}B))\n"
+    if kind == "mixed":
+        return (
+            _mk_delta(p, "class", depth)
+            + f"SubClassOf({p}ML ObjectSomeValuesFrom(r {p}C))\n"
+        )
+    raise ValueError(kind)
+
+
+def _fast_inc(text, **cfg_kw):
+    cfg = ClassifierConfig(fast_path_min_concepts=0, **cfg_kw)
+    inc = IncrementalClassifier(cfg)
+    inc.add_text(text)
+    return inc
+
+
+def _tenants(n):
+    """(prefix, delta_kind, depth) per tenant — kinds cycle so every
+    cohort mixes class-only, link, and mixed members with divergent
+    convergence depths."""
+    kinds = ["class", "link", "mixed"]
+    return [
+        (f"T{n}c{i}", kinds[i % 3], 1 + (i % 3) * 2) for i in range(n)
+    ]
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_cohort_parity_vs_solo():
+    """The acceptance bar: every member's closure byte-identical to
+    its solo execution, at sizes 2/4/8, kinds mixed (class-only, link,
+    mixed per cohort), convergence divergent.  One solo pool of 8
+    tenants backs all three sizes (the cohort legs use fresh
+    classifiers over the same content — the expensive half is shared,
+    the assertions are not weakened)."""
+    spec = _tenants(8)
+    solo = {}
+    for p, kind, depth in spec:
+        inc = _fast_inc(_mk_base(p))
+        r = inc.add_ontology(owl_loader.load(_mk_delta(p, kind, depth)))
+        r._fetch()
+        assert inc.history[-1]["path"] == "fast"
+        solo[p] = r
+
+    for size in (2, 4, 8):
+        members = []
+        for p, kind, depth in spec[:size]:
+            inc = _fast_inc(_mk_base(p))
+            idx, batch = inc._ingest(
+                owl_loader.load(_mk_delta(p, kind, depth))
+            )
+            plan = inc._delta_fast_plan(idx, cohort_shape=True)
+            assert plan is not None
+            assert cohort.delta_cohort_ready(inc, plan)
+            members.append((inc, plan, batch))
+        # the canonical roster makes heterogeneous kinds share ONE key
+        keys = {plan.roster_key() for _i, plan, _b in members}
+        assert len(keys) == 1, keys
+
+        before = COHORT_EVENTS.snapshot()
+        results = cohort.execute_delta_cohort(members)
+        after = COHORT_EVENTS.snapshot()
+        # one dispatch per joint vote, each advancing the live
+        # members — NOT one per tenant (the collapse this PR exists
+        # for)
+        votes = after["cohort_dispatches"] - before["cohort_dispatches"]
+        assert votes >= 1
+        assert after["solo_dispatches"] == before["solo_dispatches"]
+        assert (
+            after["cohort_tenant_votes"] - before["cohort_tenant_votes"]
+            <= votes * size
+        )
+        for (p, _kind, _depth), r in zip(spec[:size], results):
+            r._fetch()
+            s = solo[p]
+            assert np.array_equal(
+                np.asarray(r.packed_s), np.asarray(s.packed_s)
+            ), f"size {size}, tenant {p}: S diverged from solo"
+            assert np.array_equal(
+                np.asarray(r.packed_r), np.asarray(s.packed_r)
+            ), f"size {size}, tenant {p}: R diverged from solo"
+            assert r.derivations == s.derivations
+
+
+def test_second_same_shape_cohort_is_compile_free():
+    """Steady state: the second cohort of the same shape is all
+    registry hits — compile_s == 0.0 — and still one dispatch per
+    vote."""
+    incs = [_fast_inc(_mk_base(p)) for p in ("Sa", "Sb")]
+
+    def run(round_no):
+        members = []
+        for inc, p in zip(incs, ("Sa", "Sb")):
+            idx, batch = inc._ingest(
+                owl_loader.load(
+                    f"SubClassOf({p}R{round_no} {p}A)\n"
+                )
+            )
+            plan = inc._delta_fast_plan(idx, cohort_shape=True)
+            members.append((inc, plan, batch))
+        cohort.execute_delta_cohort(members)
+        return [inc.last_compile for inc in incs]
+
+    run(0)
+    before = COHORT_EVENTS.snapshot()
+    stats = run(1)
+    after = COHORT_EVENTS.snapshot()
+    for st in stats:
+        assert st.program_cache_hit is True
+        assert st.compile_s == 0.0
+        assert st.trace_lower_s == 0.0
+    for inc in incs:
+        rec = inc.history[-1]
+        assert rec["path"] == "cohort"
+        assert rec["delta_program_hits"] == rec["delta_programs"]
+    assert after["solo_dispatches"] == before["solo_dispatches"]
+    assert after["cohort_dispatches"] > before["cohort_dispatches"]
+
+
+def test_warmup_covers_first_cohort():
+    """cohort.warm.sizes: after warm_delta_programs with cohort sizes,
+    even the FIRST cohort a process forms is compile-free."""
+    from distel_tpu.core.incremental import warm_delta_programs
+
+    cfg = ClassifierConfig(
+        fast_path_min_concepts=0, cohort_warm_sizes="2"
+    )
+    warm_inc = _fast_inc(_mk_base("Wm"), cohort_warm_sizes="2")
+    recs = warm_delta_programs(
+        cfg, warm_inc._base_engine, warm_inc._base_idx
+    )
+    assert any(r["program"].startswith("cohort[") for r in recs)
+    members = []
+    for p in ("Wx", "Wy"):
+        inc = _fast_inc(_mk_base(p))
+        idx, batch = inc._ingest(
+            owl_loader.load(_mk_delta(p, "link"))
+        )
+        plan = inc._delta_fast_plan(idx, cohort_shape=True)
+        members.append((inc, plan, batch))
+    cohort.execute_delta_cohort(members)
+    st = members[0][0].last_compile
+    assert st.program_cache_hit is True, st.as_dict()
+    assert st.compile_s == 0.0, st.as_dict()
+
+
+# ------------------------------------------------- registry cohort path
+
+
+def test_registry_delta_cohort_matches_solo_and_counts():
+    """The serve-plane executor: registry.delta_cohort advances both
+    members under one roster, produces solo-identical taxonomies, and
+    moves the cohort counters; a member whose text fails to parse
+    fails alone."""
+    from distel_tpu.runtime.taxonomy import extract_taxonomy
+    from distel_tpu.serve.metrics import Metrics
+    from distel_tpu.serve.registry import OntologyRegistry
+
+    metrics = Metrics()
+    reg = OntologyRegistry(
+        ClassifierConfig(), metrics=metrics, fast_path_min_concepts=0
+    )
+    oa, ob = reg.new_id(), reg.new_id()
+    reg.load(oa, _mk_base("Ra"))
+    reg.load(ob, _mk_base("Rb"))
+    out = reg.delta_cohort(
+        [
+            (oa, [_mk_delta("Ra", "class", 2)]),
+            (ob, [_mk_delta("Rb", "link")]),
+        ]
+    )
+    assert out[oa]["path"] == "cohort", out[oa]
+    assert out[ob]["path"] == "cohort", out[ob]
+    assert out[oa]["cohort_size"] == 2
+    assert metrics.counter_value("distel_cohort_formed_total") == 1
+    assert metrics.counter_value("distel_cohort_deltas_total") == 2
+    # solo replay of tenant a answers identically
+    solo = _fast_inc(_mk_base("Ra"))
+    solo.add_ontology(owl_loader.load(_mk_delta("Ra", "class", 2)))
+    tax_solo = extract_taxonomy(solo.last_result).parents
+    tax_cohort = extract_taxonomy(
+        reg.classifier(oa).last_result
+    ).parents
+    assert tax_solo == tax_cohort
+    # a malformed member fails alone; the healthy one still commits
+    out = reg.delta_cohort(
+        [
+            (oa, ["SubClassOf(RaOk RaA)"]),
+            (ob, ["NotAnAxiom((("]),
+        ]
+    )
+    assert isinstance(out[ob], BaseException), out[ob]
+    assert not isinstance(out[oa], BaseException)
+    assert out[oa]["id"] == oa
+    # the solo survivor took the inline fallback, counted as such
+    assert metrics.counter_value("distel_cohort_fallback_total") >= 1
+
+
+# ----------------------------------------------- scheduler formation
+
+
+class _StubScheduler:
+    """RequestScheduler with stub executors — formation logic only, no
+    jax, no registry."""
+
+    def __init__(self, sig_of, max_size=4, wait_s=0.2, workers=2):
+        from distel_tpu.serve.scheduler import RequestScheduler
+
+        self.calls = []
+        self.cohort_calls = []
+        self._lock = threading.Lock()
+
+        def execute(key, kind, payloads):
+            with self._lock:
+                self.calls.append((key, kind, list(payloads)))
+            return {"key": key, "solo": True}
+
+        def execute_cohort(members):
+            with self._lock:
+                self.cohort_calls.append(
+                    [(k, list(p)) for k, p in members]
+                )
+            return {k: {"key": k, "cohort": len(members)} for k, _p in members}
+
+        self.sched = RequestScheduler(
+            execute,
+            workers=workers,
+            cohort_key=sig_of,
+            execute_cohort=execute_cohort,
+            cohort_max_size=max_size,
+            cohort_max_wait_s=wait_s,
+        )
+
+
+@pytest.mark.no_lockdep
+def test_scheduler_forms_cohort_across_lanes():
+    stub = _StubScheduler(lambda key: "sigX", max_size=4)
+    try:
+        reqs = [
+            stub.sched.submit(f"k{i}", "delta", f"p{i}", batchable=True)
+            for i in range(3)
+        ]
+        outs = [r.wait(10) for r in reqs]
+        assert all(o["cohort"] == 3 for o in outs), outs
+        assert len(stub.cohort_calls) == 1
+        assert sorted(k for k, _p in stub.cohort_calls[0]) == [
+            "k0", "k1", "k2",
+        ]
+        assert stub.calls == []  # nothing ran solo
+    finally:
+        stub.sched.close()
+
+
+@pytest.mark.no_lockdep
+def test_scheduler_cohort_respects_max_size_and_signature():
+    sigs = {"a": "s1", "b": "s1", "c": "s2", "d": "s1"}
+    stub = _StubScheduler(sigs.get, max_size=2, wait_s=0.3)
+    try:
+        reqs = {
+            k: stub.sched.submit(k, "delta", k, batchable=True)
+            for k in ("a", "b", "c", "d")
+        }
+        outs = {k: r.wait(10) for k, r in reqs.items()}
+        # c has a different signature: never cohorts with s1 members
+        assert outs["c"] == {"key": "c", "solo": True}
+        # s1 members cohort in groups of <= 2
+        sizes = sorted(
+            len(call) for call in stub.cohort_calls
+        )
+        assert all(s <= 2 for s in sizes)
+        n_cohorted = sum(
+            1
+            for k in ("a", "b", "d")
+            if outs[k].get("cohort", 0) >= 2
+        )
+        assert n_cohorted >= 2, outs
+    finally:
+        stub.sched.close()
+
+
+@pytest.mark.no_lockdep
+def test_scheduler_cohort_disabled_runs_inline():
+    stub = _StubScheduler(lambda key: None)  # no signature → never
+    try:
+        reqs = [
+            stub.sched.submit(f"k{i}", "delta", f"p{i}", batchable=True)
+            for i in range(3)
+        ]
+        for r in reqs:
+            assert r.wait(10)["solo"] is True
+        assert stub.cohort_calls == []
+    finally:
+        stub.sched.close()
+
+
+@pytest.mark.no_lockdep
+def test_scheduler_cohort_preserves_lane_serialization():
+    """Two queued deltas on ONE lane coalesce into that member's batch
+    (admission order preserved); the cohort spans lanes, not requests
+    within a lane."""
+    stub = _StubScheduler(lambda key: "sig", max_size=4, wait_s=0.3)
+    try:
+        r1 = stub.sched.submit("a", "delta", "a1", batchable=True)
+        r2 = stub.sched.submit("a", "delta", "a2", batchable=True)
+        r3 = stub.sched.submit("b", "delta", "b1", batchable=True)
+        for r in (r1, r2, r3):
+            r.wait(10)
+        all_members = [m for call in stub.cohort_calls for m in call]
+        by_key = dict(all_members)
+        if "a" in by_key:  # a's lane coalesced both payloads, in order
+            assert by_key["a"] == ["a1", "a2"]
+    finally:
+        stub.sched.close()
+
+
+# ------------------------------------------------------- satellites
+
+
+def test_warmup_roster_drift_zero_builds_after_warmup():
+    """Satellite: warm_delta_programs must mirror _delta_fast_path's
+    rule selection EXACTLY.  A fresh process-style registry is warmed
+    from one sample corpus; driving each canonical delta kind through
+    a fresh classifier must then build ZERO fixed-point programs (the
+    shape-keyed embed/count helpers are allowed — they are built on
+    first use by design).  Fails loudly if the two rosters ever
+    diverge."""
+    from distel_tpu.runtime.warmup import warmup_text
+
+    cfg = ClassifierConfig(fast_path_min_concepts=0)
+    PROGRAMS.clear()  # fresh process-style registry
+    rec = warmup_text(_mk_base("Wd"), cfg, profile="serve")
+    assert rec["delta_programs"] > 0
+    keys_before = set(PROGRAMS._programs)
+    for kind in ("class", "link", "mixed"):
+        p = f"Wd{kind[:2].capitalize()}"
+        inc = _fast_inc(_mk_base(p))
+        d = inc.add_ontology(owl_loader.load(_mk_delta(p, kind)))
+        assert inc.history[-1]["path"] == "fast"
+        assert inc.last_compile.program_cache_hit is True, (
+            kind,
+            inc.last_compile.as_dict(),
+        )
+        assert inc.last_compile.compile_s == 0.0, kind
+        del d
+    new_keys = set(PROGRAMS._programs) - keys_before
+    built_runs = [
+        k
+        for k in new_keys
+        if isinstance(k, tuple)
+        and len(k) >= 2
+        and k[1] in ("run", "step", "cohort_run")
+    ]
+    assert built_runs == [], (
+        "the live fast path requested fixed-point programs the warmup "
+        f"roster never built: {built_runs} — warm_delta_programs has "
+        "drifted from _delta_fast_path's rule selection"
+    )
+
+
+def test_noop_commit_reuses_published_snapshot():
+    """Satellite: an increment that derives nothing new (and grows no
+    concepts) must NOT rebuild the read snapshot — the published
+    object is reused, version and all; a deriving commit still
+    publishes fresh."""
+    from distel_tpu.serve.metrics import Metrics
+    from distel_tpu.serve.query import SnapshotStore
+    from distel_tpu.serve.registry import OntologyRegistry
+
+    metrics = Metrics()
+    reg = OntologyRegistry(
+        ClassifierConfig(),
+        metrics=metrics,
+        fast_path_min_concepts=0,
+        query=SnapshotStore(),
+    )
+    oid = reg.new_id()
+    reg.load(oid, _mk_base("Np"))
+    snap1 = reg.query.get(oid)
+    # a deriving delta publishes a NEW snapshot
+    rec = reg.delta(oid, ["SubClassOf(NpNew NpA)"])
+    snap2 = reg.query.get(oid)
+    assert snap2 is not snap1
+    assert rec["version"] == snap2.version > snap1.version
+    # re-asserting a known axiom derives nothing: same snapshot OBJECT
+    rec = reg.delta(oid, ["SubClassOf(NpA NpB)"])
+    assert rec["new_derivations"] == 0
+    snap3 = reg.query.get(oid)
+    assert snap3 is snap2, "no-op commit rebuilt the snapshot"
+    assert rec["version"] == snap2.version
+    assert (
+        metrics.counter_value("distel_query_republish_skipped_total")
+        == 1
+    )
+    # and the next deriving delta publishes again, version monotonic
+    rec = reg.delta(oid, ["SubClassOf(NpNew2 NpNew)"])
+    snap4 = reg.query.get(oid)
+    assert snap4 is not snap2 and snap4.version > snap2.version
+    assert rec["version"] == snap4.version
