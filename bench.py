@@ -653,6 +653,163 @@ def _pipeline_probe(n_classes: int = 2000, chain_depth: int = 24) -> dict:
     }
 
 
+def _cr6_tiles_probe(n_classes: int = 4000) -> dict:
+    """CR6 live-tile kernel A/B (ISSUE 13) — the re-landed r5 int8
+    tile probe, tracked: window-formulation vs live-tile engines on the
+    chain-heavy SNOMED shape, byte-identity asserted, with
+
+    * live-MAC fraction before/after (``step_cost_model`` — the 0.068
+      figure BENCH_r03 recorded is the *before* at 64k),
+    * the tile occupancy histogram and tiled-vs-window MAC volume from
+      the engine's schedule stats,
+    * dense-vs-tiled per-step wall at MATCHED convergence (same
+      iteration counts, warm best-of-3 full fixed points plus a warm
+      single public-step wall), and
+    * per-rule step attribution via ``profile_saturation`` when the
+      host has device tracing (absent on the plain CPU host: the
+      record then carries the wall-based split and says so).
+
+    Runs inside the bench child, so the DISTEL_BENCH_BACKEND_ATTEMPTS
+    retry machinery and the ``#partial`` checkpoints apply: a tunnel
+    outage mid-run produces a partial record instead of a lost one —
+    the failure mode that killed the original r5 probe."""
+    import numpy as np
+
+    from distel_tpu.runtime.instrumentation import STEP_RULE_EVENTS
+
+    text = snomed_shaped_ontology(n_classes=n_classes)
+    idx = index_ontology(normalize(parser.parse(text)))
+    mk = lambda **kw: RowPackedSaturationEngine(
+        idx, bucket=True, unroll=1, **kw
+    )
+    e_win = mk(cr6_tiles={"enable": False})
+    e_til = mk(cr6_tiles=True)
+    rec = {
+        "corpus": f"snomed_shaped_{n_classes // 1000}k",
+        "n_concepts": idx.n_concepts,
+        "n_links": idx.n_links,
+        "chain_rows": int(len(idx.chain_pairs)),
+        "tiles": dict(e_til.cr6_tiles_stats),
+    }
+    if not e_til.cr6_tiles_stats.get("active"):
+        rec["error"] = "tile schedule inactive on this corpus"
+        return rec
+    r_win, _, w_win = _saturate_timed(e_win)
+    r_til, _, w_til = _saturate_timed(e_til)
+    identical = bool(
+        np.array_equal(
+            np.asarray(r_win.packed_s), np.asarray(r_til.packed_s)
+        )
+        and np.array_equal(
+            np.asarray(r_win.packed_r), np.asarray(r_til.packed_r)
+        )
+    )
+    c_win = e_win.step_cost_model()
+    c_til = e_til.step_cost_model()
+    steps_w = max(r_win.iterations, 1)
+    steps_t = max(r_til.iterations, 1)
+
+    # warm single-superstep wall (the public all-dirty step), the
+    # per-step figure the acceptance asks for without the loop around it
+    def step_wall(engine):
+        sp, rp = engine.initial_state()
+        sp, rp = engine.step(sp, rp)  # warm the step program
+        import jax
+
+        jax.block_until_ready((sp, rp))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            o = engine.step(sp, rp)
+            jax.block_until_ready(o)
+            best = min(best, time.time() - t0)
+        return best
+
+    rec.update(
+        closure_identical=identical,
+        iterations={"window": r_win.iterations, "tiled": r_til.iterations},
+        matched_convergence=r_win.iterations == r_til.iterations,
+        wall_s_warm={"window": round(w_win, 3), "tiled": round(w_til, 3)},
+        per_step_wall_s={
+            "window": round(w_win / steps_w, 4),
+            "tiled": round(w_til / steps_t, 4),
+        },
+        step_wall_s={
+            "window": round(step_wall(e_win), 4),
+            "tiled": round(step_wall(e_til), 4),
+        },
+        tiled_speedup=round(w_win / max(w_til, 1e-9), 2),
+        mm_live_mac_fraction={
+            "window": round(
+                c_win["mm_live_macs"]
+                / max(c_win["mm_dense_equiv_macs"], 1),
+                4,
+            ),
+            "tiled": round(
+                c_til["mm_live_macs"]
+                / max(c_til["mm_dense_equiv_macs"], 1),
+                4,
+            ),
+        },
+    )
+    # per-rule device attribution — needs a tracing-capable host; the
+    # CPU fallback records the reason instead of fake numbers
+    for name, engine in (("window", e_win), ("tiled", e_til)):
+        try:
+            from distel_tpu.runtime.profiling import profile_saturation
+
+            prof = profile_saturation(engine)
+            per_step = prof["per_step_s"]
+            total = sum(per_step.values()) or 1.0
+            rec.setdefault("rule_seconds_per_step", {})[name] = per_step
+            rec.setdefault("cr6_step_share", {})[name] = round(
+                per_step.get("cr6", 0.0) / total, 4
+            )
+        except Exception as e:  # host without device tracing
+            rec.setdefault("rule_seconds_per_step", {})[name] = {
+                "error": str(e)[:160]
+            }
+    rec["step_rule_gauges"] = STEP_RULE_EVENTS.snapshot()
+    return rec
+
+
+#: named bench sections runnable standalone via ``--sections a,b`` —
+#: each still goes through main()'s probe/retry/partial machinery, so
+#: a CPU host (or a half-up tunnel) can produce a BENCH record of just
+#: the sections it can afford (BENCH_r06.json is the cr6_tiles section
+#: run this way)
+_SECTIONS = {
+    "cr6_tiles": _cr6_tiles_probe,
+    "sparse_tail": _sparse_tail_probe,
+    "pipelined_observed": _pipeline_probe,
+}
+
+
+def _run_sections(names, load1_start: float) -> None:
+    import jax
+
+    from distel_tpu.config import enable_compile_cache
+
+    enable_compile_cache()
+    out = {
+        "metric": "bench_sections",
+        "sections": list(names),
+        "platform": jax.devices()[0].platform,
+        "load1_start": round(load1_start, 2),
+    }
+    for name in names:
+        fn = _SECTIONS.get(name)
+        if fn is None:
+            out[name] = {"error": f"unknown section {name!r}"}
+            continue
+        t0 = time.time()
+        out[name] = fn()
+        out[name]["section_wall_s"] = round(time.time() - t0, 1)
+        _partial(**{name: out[name]})
+    out["load1_end"] = round(_load1(), 2)
+    print(json.dumps(out))
+
+
 def _run_bench(load1_start: float) -> None:
     import jax
 
@@ -891,6 +1048,12 @@ def _run_bench(load1_start: float) -> None:
         extra["sparse_tail"] = _sparse_tail_probe()
         _partial(sparse_tail=extra["sparse_tail"])
 
+        # ---- CR6 live-tile kernel (ISSUE 13): window vs tiled A/B at
+        # matched convergence — live-MAC fraction, occupancy, per-step
+        # wall, per-rule attribution where the host can trace
+        extra["cr6_tiles"] = _cr6_tiles_probe()
+        _partial(cr6_tiles=extra["cr6_tiles"])
+
         # ---- pipelined observed saturation (ISSUE 5): speculative
         # round dispatch with deferred frontier folds — raw walls vs
         # saturate()/sync, the loaded-observer hiding A/B, and the
@@ -965,6 +1128,15 @@ if __name__ == "__main__":
         sys.argv = [sys.argv[0]] + [
             a for a in sys.argv[1:] if a != "--child"
         ]
-        _run_bench(_load1())
+        names = None
+        for i, a in enumerate(list(sys.argv[1:]), start=1):
+            if a == "--sections" and i + 1 < len(sys.argv):
+                names = sys.argv[i + 1].replace(",", " ").split()
+            elif a.startswith("--sections="):
+                names = a.split("=", 1)[1].replace(",", " ").split()
+        if names is not None:
+            _run_sections(names, _load1())
+        else:
+            _run_bench(_load1())
     else:
         main()

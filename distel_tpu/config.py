@@ -151,6 +151,21 @@ class ClassifierConfig:
     #: consecutive below-threshold rounds required before switching to
     #: the sparse tier (switching back to dense is immediate)
     sparse_hysteresis_rounds: int = 2
+    #: live-tile CR6 formulation (rowpacked engine, scanned CR6, single
+    #: device): the role-chain join contracts role-run row tiles
+    #: against densely packed live-link tiles instead of the scanned
+    #: role-union windows — byte-identical closure per round, a
+    #: fraction of the MAC volume when the live structure is sparse
+    #: (``core/cr6_tiles.py``; BENCH_r03 put the window formulation at
+    #: 67% of the device step with 93% dead MACs)
+    cr6_tiles: bool = True
+    #: row-tile height of the live-tile CR6 contraction
+    cr6_tiles_tile_m: int = 512
+    #: link-tile width (packed live links per contraction tile)
+    cr6_tiles_tile_l: int = 256
+    #: tiled-vs-window MAC-volume ratio above which the engine keeps
+    #: the window formulation (tiles only pay on sparse live structure)
+    cr6_tiles_density_threshold: float = 0.5
     #: pipelined observation (rowpacked engine, observed runs): dense
     #: rounds depend only on device-carried state, so up to
     #: ``pipeline_depth`` rounds stay speculatively in flight while the
@@ -316,6 +331,16 @@ class ClassifierConfig:
             cfg.sparse_hysteresis_rounds = int(
                 raw["sparse_tail.hysteresis_rounds"]
             )
+        if "cr6.tiles.enable" in raw:
+            cfg.cr6_tiles = raw["cr6.tiles.enable"].lower() == "true"
+        if "cr6.tiles.tile_m" in raw:
+            cfg.cr6_tiles_tile_m = int(raw["cr6.tiles.tile_m"])
+        if "cr6.tiles.tile_l" in raw:
+            cfg.cr6_tiles_tile_l = int(raw["cr6.tiles.tile_l"])
+        if "cr6.tiles.density_threshold" in raw:
+            cfg.cr6_tiles_density_threshold = float(
+                raw["cr6.tiles.density_threshold"]
+            )
         if "pipeline.enable" in raw:
             cfg.pipeline = raw["pipeline.enable"].lower() == "true"
         if "pipeline.depth" in raw:
@@ -397,6 +422,18 @@ class ClassifierConfig:
             "density_threshold": self.sparse_density_threshold,
             "capacity_buckets": self.sparse_capacity_buckets,
             "hysteresis_rounds": self.sparse_hysteresis_rounds,
+        }
+
+    def cr6_tiles_config(self) -> Optional[dict]:
+        """The rowpacked engine's ``cr6_tiles=`` kwarg for this config
+        (None = window formulation)."""
+        if not self.cr6_tiles:
+            return None
+        return {
+            "enable": True,
+            "tile_m": self.cr6_tiles_tile_m,
+            "tile_l": self.cr6_tiles_tile_l,
+            "density_threshold": self.cr6_tiles_density_threshold,
         }
 
     def pipeline_config(self) -> dict:

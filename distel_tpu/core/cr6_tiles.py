@@ -1,0 +1,475 @@
+"""CR6 live-tile schedule: the structure-packed role-chain join.
+
+BENCH_r03 put CR6 at 67% of the device step with a live-MAC fraction of
+0.068: the scanned window formulation contracts each row chunk against
+every L-window its role UNION can touch, so a chunk mixing role runs
+pays every run's links for every row — >93% of the executed MACs are
+dead on the factored mask alone (``m6[p, role(l)] = 0`` whenever link
+``l``'s role is not a subrole of ROW ``p``'s chain role).  No gating on
+that formulation can recover the loss: the dead MACs are *inside* the
+windows it executes.
+
+This module rebuilds the CR6 contraction around the join's live
+structure instead (the reference's per-role hash-join partitioning,
+``RolePairHandler.java:396-444``, taken to row granularity):
+
+* the role-sorted ``chain_pairs`` table splits into **role-run row
+  tiles** (≤ ``tile_m`` rows, runs merged only while the merged tile's
+  rows × union-live-links MAC volume stays near the parts' sum), so
+  each row tile's rows agree about which links can satisfy them;
+* each row tile's live links — links whose role is a transitive subrole
+  of some row's chain role — are **packed densely into ``tile_l``-slot
+  link tiles** (live-row gather → tile): the contraction runs
+  ``[tile_m, tile_l] @ [tile_l, W]`` only over occupied tiles, and the
+  off-role interior the window schedule still sweeps never exists;
+* the window-term operand (factored mask ∧ bit-table ∧ liveness) is
+  built per tile and the outputs flow into the engine's existing
+  deferred **segmented-OR write groups** — the S/R bit-tables never
+  round-trip HBM per rule, and the write cascade (group boundaries,
+  target sets) is bit-compatible with the scanned window formulation,
+  which is what makes the tiled closure byte-identical to dense per
+  round (``tests/test_cr6_tiles.py`` pins it).
+
+Backend split: the schedule is backend-agnostic bit-algebra.  The
+pure-jax path (gather + ``PackedColsMatmulPlan`` XLA contraction) runs
+and wins on CPU — it is what the r5 int8 probe was sizing before the
+tunnel outage killed it.  On a TPU host the same per-tile contraction
+lowers through the Mosaic packed-columns kernel
+(``ops/bitmatmul._packed_cols_sparse_kernel``): operands stay packed in
+VMEM and the per-tile skip flags drop the DMA + MXU work of tiles the
+liveness multiplier zeroed.  :func:`pallas_mosaic_supported` is the
+capability probe the tests (and any caller) guard on — it attempts a
+real lowering once and caches the answer, so the Pallas-path tests
+auto-skip on CPU hosts and un-skip the moment a TPU appears (the
+``tests/sharding_support.py`` pattern).
+
+Bucket-mode purity: every ontology-derived array built here (row ids,
+mask rows, link-tile ids/validity, write-plan order/targets) rides in
+the engine's runtime-argument pytree; only the quantized tile COUNTS
+(row tiles, link tiles per row tile, write-plan structure) reach the
+traced program, via the bucket signature — same-rung ontologies share
+one compiled executable through ``PROGRAMS`` and the persistent HLO
+cache, exactly like the window formulation.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
+from distel_tpu.ops.bitpack import SegmentedRowOr
+
+#: default knobs (mirrored by ``ClassifierConfig.cr6_tiles_*`` /
+#: ``cr6.tiles.*`` properties keys — the engine normalizes through
+#: these, so config-plane and direct-construction defaults agree)
+TILE_DEFAULTS = {
+    "enable": True,
+    "tile_m": 512,
+    "tile_l": 256,
+    "density_threshold": 0.5,
+}
+
+#: occupancy-histogram bin edges (fraction of a link tile's slots
+#: holding live links) — the bench ``cr6_tiles`` section records this
+OCCUPANCY_BINS = (0.25, 0.5, 0.75, 1.0)
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_mosaic_supported() -> bool:
+    """Can ``jax.experimental.pallas`` lower a real (non-interpret)
+    TPU kernel on the current default backend?  False on CPU hosts
+    ("Only interpret mode is supported on CPU backend"); True when a
+    TPU host appears — the capability guard the Pallas-path tests and
+    the engine's kernel selection key on."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        plan = PackedColsMatmulPlan(
+            8, 32, 4, tm=8, tl=32, tw=4, use_xla=False, interpret=False
+        )
+        a = jnp.zeros((8, 32), jnp.int8)
+        b = jnp.zeros((32, 4), jnp.uint32)
+        jax.block_until_ready(plan(a, b))
+        return True
+    except Exception:
+        return False
+
+
+@dataclass
+class Cr6TileSchedule:
+    """One engine's static live-tile schedule (host arrays; the engine
+    converts the slab fields to device arrays in its argument pytree).
+
+    Shapes: ``n_rt`` row tiles of ``tile_m`` rows; ``nt`` link tiles of
+    ``tile_l`` slots per row tile (both counts quantized in bucket
+    mode, padded entries inert).  ``groups`` mirrors the window
+    formulation's deferred write-group row boundaries exactly, so the
+    intra-step read/write cascade — and with it per-round byte
+    identity — is preserved."""
+
+    tile_m: int
+    tile_l: int
+    n_rt: int
+    nt: int
+    #: [n_rt, tile_m] int32 — l2 (second-leg) R-row ids, padded dead
+    rows: np.ndarray
+    #: [n_rt, tile_m, n_roles_pad+1] int8 — factored mask rows
+    mrows: np.ndarray
+    #: [n_rt, tile_m] int32 — per-row fd source (l2 // lc; pad = the
+    #: appended always-False dirty slot)
+    fdx: np.ndarray
+    #: [n_rt, nt, tile_l] int32 — live link ids (padded dead)
+    tids: np.ndarray
+    #: [n_rt, nt, tile_l] bool — slot validity (False = padding)
+    tval: np.ndarray
+    #: [(rt0, rt1, SegmentedRowOr, order_np, targets_np)] — deferred
+    #: write groups over row-tile ranges; order/targets are the plan's
+    #: data content (runtime args in bucket mode, constants otherwise)
+    groups: List[tuple]
+    #: row spans [(a0, a1, roles)] per row tile, persisted for
+    #: ``rebind_role_closure`` (re-deriving them would risk desync)
+    spans: List[tuple]
+    #: live link ids per row tile (host copy, pre-padding) — rebind
+    #: fit checks and the occupancy stats read these
+    live_per_span: List[np.ndarray]
+    #: schedule statistics (occupancy histogram, MAC volumes)
+    stats: dict = field(default_factory=dict)
+
+    def signature_parts(self) -> tuple:
+        """Traced-structure record for the engine's bucket signature:
+        everything that shapes the jaxpr (counts, write-plan
+        structure), nothing that is argument content."""
+        return (
+            self.tile_m,
+            self.tile_l,
+            self.n_rt,
+            self.nt,
+            tuple(
+                (rt0, rt1, plan.structure())
+                for rt0, rt1, plan, _o, _t in self.groups
+            ),
+        )
+
+
+def _role_run_spans(
+    tab_roles: np.ndarray,
+    bounds: List[int],
+    tile_m: int,
+    live_count,
+) -> List[Tuple[int, int]]:
+    """Row spans of the role-sorted table: split at the write-group
+    ``bounds`` (cascade preservation) and at role-run boundaries, then
+    greedily re-merged while the merged span's rows × union-live MAC
+    volume stays within 1.25x of the parts' sum and under ``tile_m``
+    rows — role-poor tables still get few big MXU-friendly tiles,
+    role-rich ones stay role-pure."""
+    n = len(tab_roles)
+    spans: List[Tuple[int, int]] = []
+    for b0, b1 in zip(bounds[:-1], bounds[1:]):
+        b1r = min(b1, n)
+        if b0 >= b1r:
+            continue
+        seg = tab_roles[b0:b1r]
+        starts = np.flatnonzero(np.r_[True, seg[1:] != seg[:-1]]) + b0
+        ends = np.r_[starts[1:], b1r]
+        pieces = []
+        for s, e in zip(starts, ends):
+            for o in range(s, e, tile_m):
+                pieces.append((o, min(o + tile_m, e)))
+        cur = None
+        for s, e in pieces:
+            macs = (e - s) * live_count(tab_roles[s:e])
+            if cur is None:
+                cur = [s, e, macs]
+                continue
+            nrows = e - cur[0]
+            nmacs = nrows * live_count(tab_roles[cur[0]:e])
+            if nrows <= tile_m and nmacs <= 1.25 * (cur[2] + macs):
+                cur[1], cur[2] = e, cur[2] + macs
+            else:
+                spans.append((cur[0], cur[1]))
+                cur = [s, e, macs]
+        if cur is not None:
+            spans.append((cur[0], cur[1]))
+    return spans
+
+
+def build_cr6_tile_schedule(
+    tab_roles: np.ndarray,
+    l2_rows: np.ndarray,
+    targets: np.ndarray,
+    mask_tab: np.ndarray,
+    link_roles: np.ndarray,
+    role_closure: np.ndarray,
+    *,
+    lc: int,
+    n_lchunks: int,
+    tile_m: int,
+    tile_l: int,
+    group_bounds: List[int],
+    link_window: Optional[Tuple[int, int]] = None,
+    n_rows: Optional[int] = None,
+    dead_link: int,
+    pad_target: int,
+    tile_headroom: int = 0,
+    q1=None,
+    qn=None,
+    h_override: Optional[np.ndarray] = None,
+    fit_schedule: Optional["Cr6TileSchedule"] = None,
+) -> Optional[Cr6TileSchedule]:
+    """Build (or re-fit) the live-tile schedule for one CR6 table.
+
+    ``group_bounds``: ROW indices of the window formulation's deferred
+    write-group boundaries (``[0, g0·rk, g1·rk, ..., n_grid]``) — row
+    tiles never straddle one, and the tile write groups cover exactly
+    the same row ranges, so the tiled step's intra-step cascade matches
+    the window step's bit for bit.  ``n_rows``: quantized row-grid
+    length of a bucketed engine (rows past the real table become inert
+    pad tiles so the tile counts stay rung-determined).  ``q1``/``qn``:
+    the engine's structure-count / segment-histogram quantizers (None =
+    exact mode).  ``h_override``: recompute liveness under a GROWN role
+    closure — the ``rebind_role_closure`` path; combined with
+    ``fit_schedule`` (the compiled schedule being re-bound) the builder
+    reuses its spans/slots and returns None when the grown closure
+    needs more link tiles than the compiled program has slots for (the
+    caller then falls back to a rebuild).
+
+    Returns None when re-fitting fails; an all-inert schedule (zero
+    live links anywhere) is returned as a schedule with ``nt`` slots
+    all invalid — the engine treats it like the window path treats an
+    all-dead slab."""
+    h = np.asarray(
+        role_closure if h_override is None else h_override
+    ).astype(bool)
+    n_real = len(tab_roles)
+    n_grid = n_real if n_rows is None else int(n_rows)
+    link_roles = np.asarray(link_roles)
+
+    def live_links(roles) -> np.ndarray:
+        roles = np.unique(np.asarray(roles))
+        roles = roles[roles < h.shape[1]]
+        if roles.size == 0:
+            return np.zeros(0, np.int64)
+        rel = np.flatnonzero(h[:, roles].any(axis=1))
+        live = np.flatnonzero(np.isin(link_roles, rel))
+        if link_window is not None:
+            w0, w1 = link_window
+            live = live[(live >= w0) & (live < w1)]
+        return live
+
+    if fit_schedule is None:
+        bounds = sorted({0, n_grid, *(min(b, n_grid) for b in group_bounds)})
+        # link_window engines (the incremental CROSS programs) keep the
+        # row-span grid VALUE-independent: the windowed live counts are
+        # per-delta content, and letting them steer the greedy merge
+        # would fold each delta's link positions into the span count —
+        # i.e. into the bucket signature — re-opening the serve-time
+        # recompiles PR 10 closed.  A constant live count merges runs
+        # up to tile_m rows at role/group boundaries only, which are
+        # corpus-static.
+        live_count = (
+            (lambda r: 0)
+            if link_window is not None
+            else (lambda r: len(live_links(r)))
+        )
+        spans = _role_run_spans(
+            tab_roles, [b for b in bounds if b <= n_real] + [n_real],
+            tile_m, live_count,
+        )
+        # quantization-pad rows (past the real table) become inert pad
+        # spans so the row-tile count is a pure function of the grid
+        pad_bounds = [b for b in bounds if b >= n_real]
+        if pad_bounds and pad_bounds[0] < n_grid:
+            lo = n_real
+            for b in pad_bounds[1:] + [n_grid]:
+                for o in range(lo, b, tile_m):
+                    spans.append((o, min(o + tile_m, b)))
+                lo = b
+        spans = [
+            (a0, a1, np.unique(tab_roles[a0:min(a1, n_real)]))
+            for a0, a1 in spans
+        ]
+    else:
+        spans = fit_schedule.spans
+
+    live_per_span = [live_links(roles) for _a0, _a1, roles in spans]
+    max_tiles = max(
+        [-(-len(lv) // tile_l) for lv in live_per_span], default=0
+    )
+    if fit_schedule is not None:
+        nt = fit_schedule.nt
+        if max_tiles > nt:
+            return None  # grown closure overflows the compiled slots
+        n_rt = fit_schedule.n_rt
+    else:
+        nt = max_tiles + int(tile_headroom)
+        if q1 is not None:
+            nt = q1(nt) if nt else 0
+        n_rt = len(spans)
+        if q1 is not None:
+            n_rt = q1(max(n_rt, 1))
+
+    rows = np.full((n_rt, tile_m), dead_link, np.int32)
+    mrows = np.zeros((n_rt, tile_m, mask_tab.shape[1]), np.int8)
+    # fd pad = n_lchunks: the engine appends one always-False slot to
+    # dirty_l before the gather, so pad rows never re-dirty a tile
+    fdx = np.full((n_rt, tile_m), n_lchunks, np.int32)
+    # the target grid only feeds the write plans, which a re-fit
+    # reuses verbatim — skip the allocation there
+    tgt = (
+        np.full((n_rt, tile_m), pad_target, np.int64)
+        if fit_schedule is None
+        else None
+    )
+    tids = np.full((n_rt, nt, tile_l), dead_link, np.int32)
+    tval = np.zeros((n_rt, nt, tile_l), bool)
+    occupancy = []
+    for i, ((a0, a1, _roles), lv) in enumerate(zip(spans, live_per_span)):
+        a1r = min(a1, n_real)
+        k = a1r - a0
+        if k > 0:
+            rows[i, :k] = l2_rows[a0:a1r]
+            mrows[i, :k] = mask_tab[a0:a1r]
+            fdx[i, :k] = l2_rows[a0:a1r] // lc
+            if tgt is not None:
+                tgt[i, :k] = targets[a0:a1r]
+        for t in range(-(-len(lv) // tile_l)):
+            seg = lv[t * tile_l : (t + 1) * tile_l]
+            tids[i, t, : len(seg)] = seg
+            tval[i, t, : len(seg)] = True
+            occupancy.append(len(seg) / tile_l)
+
+    def tile_stats() -> dict:
+        total_live = int(sum(len(lv) for lv in live_per_span))
+        occupied_slots = int(tval.sum())
+        hist = [0] * len(OCCUPANCY_BINS)
+        for o in occupancy:
+            for bi, edge in enumerate(OCCUPANCY_BINS):
+                if o <= edge:
+                    hist[bi] += 1
+                    break
+        return {
+            "tile_m": tile_m,
+            "tile_l": tile_l,
+            "n_row_tiles": int(n_rt),
+            "n_link_tiles": int(nt),
+            "live_links": total_live,
+            "occupied_slots": occupied_slots,
+            "tile_macs": occupied_slots * tile_m,
+            "occupancy_histogram": {
+                f"<= {edge}": hist[bi]
+                for bi, edge in enumerate(OCCUPANCY_BINS)
+            },
+            "mean_occupancy": (
+                round(float(np.mean(occupancy)), 4) if occupancy else 0.0
+            ),
+        }
+
+    # deferred write groups over the SAME row ranges as the window
+    # formulation's groups (cascade preservation — see the module
+    # docstring); pad row-tile slots target the dead row, pad seg-OR
+    # slots gather the appended all-zero output row.  A re-fit
+    # (``fit_schedule``) reuses the compiled schedule's groups verbatim
+    # — the closure changes liveness and masks, never rows or targets.
+    if fit_schedule is not None:
+        return Cr6TileSchedule(
+            tile_m=tile_m,
+            tile_l=tile_l,
+            n_rt=int(n_rt),
+            nt=int(nt),
+            rows=rows,
+            mrows=mrows,
+            fdx=fdx,
+            tids=tids,
+            tval=tval,
+            groups=fit_schedule.groups,
+            spans=spans,
+            live_per_span=live_per_span,
+            # fully recomputed — a rebound schedule's occupancy and MAC
+            # volume must describe the GROWN closure, not the build-time
+            # one (stale figures would contradict occupied_slots)
+            stats=tile_stats(),
+        )
+    span_starts = [a0 for a0, _a1, _r in spans] + [n_grid]
+    groups = []
+    bound_list = sorted({0, n_grid, *(min(b, n_grid) for b in group_bounds)})
+    for b0, b1 in zip(bound_list[:-1], bound_list[1:]):
+        rt0 = int(np.searchsorted(span_starts, b0))
+        rt1 = int(np.searchsorted(span_starts, b1))
+        rt1 = max(rt1, rt0)
+        if fit_schedule is None and rt1 == rt0 and b1 > b0:
+            continue  # bound past every span (all-pad tail, no tiles)
+        if rt1 > n_rt:
+            rt1 = n_rt
+        tg = tgt[rt0:rt1].reshape(-1)
+        if qn is not None:
+            plan = SegmentedRowOr.quantized(
+                tg, qn, pad_target, (rt1 - rt0) * tile_m
+            )
+        else:
+            plan = SegmentedRowOr(tg)
+        groups.append(
+            (
+                rt0, rt1, plan,
+                plan.order.astype(np.int32),
+                plan.targets.astype(np.int32),
+            )
+        )
+    if fit_schedule is None and n_rt > len(spans):
+        # bucket quantization pad row tiles: fold them into the LAST
+        # group (inert rows targeting the dead row keep the plan a
+        # no-op) so every row tile is covered by exactly one write
+        rt0, rt1, _p, _o, _t = groups[-1]
+        tg = tgt[rt0:n_rt].reshape(-1)
+        plan = (
+            SegmentedRowOr.quantized(
+                tg, qn, pad_target, (n_rt - rt0) * tile_m
+            )
+            if qn is not None
+            else SegmentedRowOr(tg)
+        )
+        groups[-1] = (
+            rt0, n_rt, plan,
+            plan.order.astype(np.int32), plan.targets.astype(np.int32),
+        )
+
+    stats = tile_stats()
+    return Cr6TileSchedule(
+        tile_m=tile_m,
+        tile_l=tile_l,
+        n_rt=int(n_rt),
+        nt=int(nt),
+        rows=rows,
+        mrows=mrows,
+        fdx=fdx,
+        tids=tids,
+        tval=tval,
+        groups=groups,
+        spans=spans,
+        live_per_span=live_per_span,
+        stats=stats,
+    )
+
+
+def make_tile_matmul(
+    tile_m: int, tile_l: int, words: int, mm_kw: dict
+) -> PackedColsMatmulPlan:
+    """The one per-tile contraction plan a tile schedule runs under:
+    ``[tile_m, tile_l] @ [tile_l, words]`` in the packed-columns AND-OR
+    semiring.  On the XLA (CPU) path the m-axis pads to 8 — the
+    Mosaic grid tile would be pure wasted MACs there; on the Pallas
+    path the kernel's per-tile skip flags are forced ON (the liveness
+    multiplier zeroes whole dead tiles, and skipping their DMA + MXU
+    work is the TPU half of the live-tile win)."""
+    kw = dict(mm_kw)
+    if kw.get("use_xla"):
+        kw.setdefault("tm", max(((tile_m + 7) // 8) * 8, 8))
+    else:
+        kw.setdefault("skip_zero_tiles", True)
+        kw.setdefault("tl", tile_l)
+    return PackedColsMatmulPlan(tile_m, tile_l, words, **kw)

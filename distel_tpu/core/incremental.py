@@ -140,6 +140,10 @@ def delta_program_kwargs(
         l_chunk=base.lc,
         mesh=mesh,
         matmul_dtype=config.matmul_jnp_dtype(),
+        # the delta/cross programs run the same CR6 formulation the
+        # config selects for the base — a warmed roster only pays off
+        # if it is byte-identical to what live traffic will request
+        cr6_tiles=config.cr6_tiles_config(),
     )
     if bucket:
         kw.update(

@@ -77,6 +77,11 @@ from distel_tpu.core.engine import (
     fresh_init_total,
     observed_loop,
 )
+from distel_tpu.core.cr6_tiles import (
+    TILE_DEFAULTS as _CR6_TILE_DEFAULTS,
+    build_cr6_tile_schedule,
+    make_tile_matmul,
+)
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.core.program_cache import (
     PROGRAMS,
@@ -314,6 +319,7 @@ class RowPackedSaturationEngine:
         state_dims: Optional[Tuple[int, int]] = None,
         sparse_tail: Optional[dict] = None,
         pipeline: Optional[dict] = None,
+        cr6_tiles: Optional[dict] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -408,13 +414,33 @@ class RowPackedSaturationEngine:
         adaptive controller drains the queue before any sparse tier
         switch, so a switch can shift later by up to depth-1 rounds
         (within the hysteresis slack) without changing what any round
-        derives."""
+        derives.
+        ``cr6_tiles``: live-tile CR6 formulation (``core/cr6_tiles.py``;
+        None = off): the role-chain contraction runs over role-run row
+        tiles × densely packed live-link tiles instead of the scanned
+        role-union windows — same deferred write-group cascade, so the
+        closure stays byte-identical to the window formulation per
+        round.  Keys: ``enable``, ``tile_m``/``tile_l`` (tile shape),
+        ``density_threshold`` (tiled-vs-window MAC-volume ratio above
+        which the engine quietly keeps the window formulation — tiles
+        only pay when the live structure is sparse).  Scanned-CR6
+        single-device engines only (the window formulation stays the
+        mesh/unrolled path); the tile indices ride as runtime args, so
+        bucket-mode program sharing survives with the tile COUNTS
+        folded into the bucket signature."""
         if rules is not None:
             unknown = set(rules) - {f"CR{i}" for i in range(1, 7)}
             if unknown:
                 raise ValueError(f"unknown rules: {sorted(unknown)}")
         self._rules = rules
         self._window_headroom = int(window_headroom)
+        #: live-tile CR6 config (None = window formulation everywhere);
+        #: normalized up front — the scan-mode plan build consults it
+        self._cr6_tiles_cfg = self._normalize_cr6_tiles_cfg(cr6_tiles)
+        #: retained for rebind_role_closure's tile re-fit (the tile
+        #: schedule recomputes liveness under the grown closure against
+        #: the same link window the compiled program was built with)
+        self._link_window = link_window
         self.idx = idx
         self.mesh = mesh
         self.word_axis = word_axis
@@ -1248,15 +1274,98 @@ class RowPackedSaturationEngine:
             )
             self._cr4_tiles, self._cr6_tiles = [], []
             self._cr4_dropped_roles = self._cr6_dropped_roles = []
+            # ---- CR6 live-tile schedule (core/cr6_tiles.py): pack the
+            # role-run row tiles against their own live links and keep
+            # the window formulation only as the sparse tier's (and the
+            # rebind bookkeeping's) structure.  Build-time decision —
+            # tiled vs window MAC volume under the configured
+            # threshold — so it folds into the bucket signature below.
+            self._tiles6 = None
+            self.cr6_tiles_stats = {"active": False, "reason": "off"}
+            if (
+                self._cr6_tiles_cfg is not None
+                and self._scan6 is not None
+                and mesh is None
+            ):
+                tcfg = self._cr6_tiles_cfg
+                d6 = self._scan6
+                gb = [g0 * rk6 for g0, _g1, _p, _r in d6["groups"]]
+                gb.append(d6["groups"][-1][1] * rk6)
+                # tile_m clamps to the (padded) table height: a tiny
+                # chain table under a 512-row tile would charge the MAC
+                # volume (and the density decision) for pad rows that
+                # outnumber the real ones.  Bucket mode clamps against
+                # the rung-quantized grid, keeping it rung-derived.
+                n_grid6 = (
+                    self._k6_rows
+                    if self._bucket
+                    else len(idx.chain_pairs)
+                )
+                tm_eff = max(
+                    min(tcfg["tile_m"], _pad_up(max(n_grid6, 1), 8)), 8
+                )
+                sched = build_cr6_tile_schedule(
+                    idx.chain_pairs[:, 0], self._l26,
+                    idx.chain_pairs[:, 2], m6,
+                    self._link_roles, h,
+                    lc=self.lc, n_lchunks=self.n_lchunks,
+                    tile_m=tm_eff, tile_l=tcfg["tile_l"],
+                    group_bounds=gb, link_window=link_window,
+                    n_rows=self._k6_rows if self._bucket else None,
+                    dead_link=self.nl - 1,
+                    pad_target=self._dead_l if self._bucket else 0,
+                    tile_headroom=self._window_headroom,
+                    q1=self._q1 if self._bucket else None,
+                    qn=self._qn if self._bucket else None,
+                )
+                window_macs = int(d6["n_windows"].sum()) * self.lc * rk6
+                tile_macs = (
+                    sched.stats["occupied_slots"] * sched.tile_m
+                )
+                density = tile_macs / max(float(window_macs), 1.0)
+                self.cr6_tiles_stats = {
+                    "active": False,
+                    "density": round(density, 4),
+                    "window_slot_rows": window_macs,
+                    "tile_slot_rows": tile_macs,
+                    **sched.stats,
+                }
+                # link_window engines (the incremental cross programs)
+                # take tiles unconditionally: their contraction is tiny
+                # either way, and a per-delta density flip would fold
+                # the delta's link CONTENT into the bucket signature —
+                # the steady-state recompile hazard the value-
+                # independent span grid above exists to prevent
+                if (
+                    density <= tcfg["density_threshold"]
+                    or link_window is not None
+                ):
+                    self._tiles6 = sched
+                    self.cr6_tiles_stats["active"] = True
+                else:
+                    # live structure too dense for packing to pay:
+                    # keep the window formulation, loudly in the stats
+                    self.cr6_tiles_stats["reason"] = (
+                        "density above threshold"
+                    )
+            elif self._cr6_tiles_cfg is not None:
+                self.cr6_tiles_stats["reason"] = (
+                    "no scanned CR6" if self._scan6 is None else "mesh"
+                )
             self._masks = (
                 jnp.asarray(self._fillers.astype(np.int32)),
                 jnp.asarray(self._link_roles),
                 self._scan4["slabs"] if self._scan4 else (),
                 self._scan6["slabs"] if self._scan6 else (),
+                self._t6_device_slabs(),
             )
         else:
             self._scan4 = self._scan6 = None
             self._scan4_dropped = self._scan6_dropped = []
+            self._tiles6 = None
+            self.cr6_tiles_stats = {
+                "active": False, "reason": "unrolled CR6 formulation",
+            }
             self._cr4_chunks, self._cr4_tiles, self._cr4_dropped_roles = (
                 build_tiles(
                     self._cr4_chunks, lambda raw: idx.nf4[raw, 0], self.lc4
@@ -1319,9 +1428,21 @@ class RowPackedSaturationEngine:
                 if self._scan4
                 else []
             )
-            self._cr6_mm = (
-                [scan_mm(self._scan_rk[1], lc)] if self._scan6 else []
-            )
+            if self._tiles6 is not None:
+                # the ONE per-tile plan of the live-tile formulation:
+                # [tile_m, tile_l] against the packed gathered rows
+                # (cr6_tiles.make_tile_matmul forces the Pallas skip
+                # flags on when the Mosaic kernel is in play)
+                self._cr6_mm = [
+                    make_tile_matmul(
+                        self._tiles6.tile_m, self._tiles6.tile_l, wl,
+                        mm_kw,
+                    )
+                ]
+            else:
+                self._cr6_mm = (
+                    [scan_mm(self._scan_rk[1], lc)] if self._scan6 else []
+                )
         else:
             self._cr4_mm = [
                 PackedColsMatmulPlan(len(raw), self.lc4, wl, **mm_kw)
@@ -1371,10 +1492,16 @@ class RowPackedSaturationEngine:
                 g[2].targets
                 for g in (self._scan4["groups"] if self._scan4 else [])
             ]
-            w6_targets = [
-                g[2].targets
-                for g in (self._scan6["groups"] if self._scan6 else [])
-            ]
+            if self._tiles6 is not None:
+                # live-tile CR6: the change vectors come from the tile
+                # write groups, so the layered maps must index THEIR
+                # emission order, not the window grid's
+                w6_targets = [g[2].targets for g in self._tiles6.groups]
+            else:
+                w6_targets = [
+                    g[2].targets
+                    for g in (self._scan6["groups"] if self._scan6 else [])
+                ]
         else:
             w4_targets = [
                 piece.targets for _, _, piece in self._cr4_chunks
@@ -1445,6 +1572,15 @@ class RowPackedSaturationEngine:
                 "s6": self._scan6["slabs"] if self._scan6 else (),
                 "g4": self._scan4["group_args"] if self._scan4 else (),
                 "g6": self._scan6["group_args"] if self._scan6 else (),
+                # live-tile CR6 content (empty when window formulation
+                # is active): slab arrays + per-group write-plan args
+                "t6": self._t6_device_slabs(),
+                "gt6": tuple(
+                    (i32(order), i32(tgts))
+                    for _rt0, _rt1, _p, order, tgts in (
+                        self._tiles6.groups if self._tiles6 else ()
+                    )
+                ),
                 "sl": tuple(i32(pm) for pm in self._s_layers),
                 "rl": tuple(i32(pm) for pm in self._r_layers),
                 "gate_rows": tuple(gate_rows),
@@ -1779,7 +1915,12 @@ class RowPackedSaturationEngine:
             # multipliers instead of a cond)
             for g in self._scan4["groups"] if self._scan4 else []:
                 readers.append(("SR", g[3]))
-            for _g in self._scan6["groups"] if self._scan6 else []:
+            cr6_groups = (
+                self._tiles6.groups
+                if self._tiles6 is not None
+                else (self._scan6["groups"] if self._scan6 else [])
+            )
+            for _g in cr6_groups:
                 readers.append(("RR", None))
         else:
             for raw, _inv, plan in self._cr4_chunks:
@@ -1874,6 +2015,55 @@ class RowPackedSaturationEngine:
                 f"(got {cfg['hysteresis_rounds']!r})"
             )
         return cfg
+
+    _CR6_TILE_DEFAULTS = dict(_CR6_TILE_DEFAULTS)
+
+    @classmethod
+    def _normalize_cr6_tiles_cfg(cls, raw) -> Optional[dict]:
+        """Resolved live-tile CR6 config (None = window formulation).
+        Degenerate tile shapes are rejected at construction, not rounds
+        into a run: a sub-8-row or sub-32-slot tile would break the
+        packed contraction's alignment assumptions silently."""
+        if not raw:
+            return None
+        cfg = dict(cls._CR6_TILE_DEFAULTS)
+        if raw is not True:
+            unknown = set(raw) - set(cfg)
+            if unknown:
+                raise ValueError(
+                    f"unknown cr6_tiles keys: {sorted(unknown)}"
+                )
+            cfg.update(raw)
+        if not cfg["enable"]:
+            return None
+        cfg["tile_m"] = int(cfg["tile_m"])
+        cfg["tile_l"] = int(cfg["tile_l"])
+        if cfg["tile_m"] < 8 or cfg["tile_l"] < 32:
+            raise ValueError(
+                "cr6_tiles tile_m must be >= 8 and tile_l >= 32 "
+                f"(got {cfg['tile_m']!r}, {cfg['tile_l']!r})"
+            )
+        if not (0.0 < float(cfg["density_threshold"])):
+            raise ValueError(
+                "cr6_tiles density_threshold must be > 0 "
+                f"(got {cfg['density_threshold']!r})"
+            )
+        return cfg
+
+    def _t6_device_slabs(self):
+        """Device copies of the live-tile slab arrays — the CR6 tile
+        content of the runtime-argument pytree (empty when the window
+        formulation is active)."""
+        t = self._tiles6
+        if t is None:
+            return ()
+        return (
+            jnp.asarray(t.rows),
+            jnp.asarray(t.mrows),
+            jnp.asarray(t.fdx),
+            jnp.asarray(t.tids),
+            jnp.asarray(t.tval),
+        )
 
     _PIPELINE_DEFAULTS = {"enable": True, "depth": 2}
 
@@ -2459,6 +2649,12 @@ class RowPackedSaturationEngine:
             self._p1.structure(), self._p2.structure(),
             self._p3.structure(),
             scan_sig(self._scan4), scan_sig(self._scan6),
+            # live-tile CR6 structure: the formulation choice AND the
+            # quantized tile counts shape the jaxpr, so two engines
+            # share a program only when both resolved identically
+            self._tiles6.signature_parts()
+            if self._tiles6 is not None
+            else None,
             len(self._s_layers), len(self._r_layers),
             self._window_headroom, gate,
             self._dead_c, self._dead_l,
@@ -2767,7 +2963,52 @@ class RowPackedSaturationEngine:
                 # host copy for the sparse tier's chunk-activity fold
                 # must track the slab swap
                 new_slabs[key + "_np"] = tval_s
+            # ---- live-tile CR6: re-fit the tile schedule under the
+            # grown closure (same spans, same write groups, same slot
+            # counts) BEFORE any swap — a grown closure needing more
+            # link tiles than the compiled program has slots refuses
+            # the rebind with the engine untouched
+            new_tiles6 = None
+            if self._tiles6 is not None:
+                new_tiles6 = build_cr6_tile_schedule(
+                    idx.chain_pairs[:, 0], self._l26,
+                    idx.chain_pairs[:, 2], m6_new,
+                    self._link_roles, idx.role_closure,
+                    lc=self.lc, n_lchunks=self.n_lchunks,
+                    tile_m=self._tiles6.tile_m,
+                    tile_l=self._tiles6.tile_l,
+                    group_bounds=[],
+                    link_window=self._link_window,
+                    n_rows=self._k6_rows if self._bucket else None,
+                    dead_link=self.nl - 1,
+                    pad_target=self._dead_l if self._bucket else 0,
+                    q1=self._q1 if self._bucket else None,
+                    qn=self._qn if self._bucket else None,
+                    h_override=h_new,
+                    fit_schedule=self._tiles6,
+                )
+                if new_tiles6 is None:
+                    return False  # tile slots exhausted: full rebuild
             # ---- all checks passed: swap atomically
+            if new_tiles6 is not None:
+                self._tiles6 = new_tiles6
+                # refresh the derived MAC figures too — occupied_slots
+                # grew under the new closure, and the stale density
+                # would contradict it
+                win_macs = self.cr6_tiles_stats.get("window_slot_rows")
+                tile_macs = new_tiles6.stats["tile_macs"]
+                self.cr6_tiles_stats = dict(
+                    self.cr6_tiles_stats,
+                    **new_tiles6.stats,
+                    tile_slot_rows=tile_macs,
+                    **(
+                        {"density": round(
+                            tile_macs / max(float(win_macs), 1.0), 4
+                        )}
+                        if win_macs
+                        else {}
+                    ),
+                )
             if self._scan4 is not None:
                 self._scan4["slabs"] = new_slabs["s4"]
                 self._scan4["n_windows"] = new_slabs["s4_nw"]
@@ -2784,6 +3025,7 @@ class RowPackedSaturationEngine:
                     self._masks,
                     s4=self._scan4["slabs"] if self._scan4 else (),
                     s6=self._scan6["slabs"] if self._scan6 else (),
+                    t6=self._t6_device_slabs(),
                 )
             else:
                 self._masks = (
@@ -2791,6 +3033,7 @@ class RowPackedSaturationEngine:
                     self._masks[1],
                     self._scan4["slabs"] if self._scan4 else (),
                     self._scan6["slabs"] if self._scan6 else (),
+                    self._t6_device_slabs(),
                 )
         else:
             new_tiles = {}
@@ -2891,6 +3134,21 @@ class RowPackedSaturationEngine:
             if d is None:
                 continue
             rk, lcn = d["rk"], d["lcn"]
+            if d is self._scan6 and self._tiles6 is not None:
+                # live-tile CR6: the contraction touches the packed
+                # live-link tiles only — gathered rows, subt gathers,
+                # and the tile write plans' RMW + re-gather traffic
+                t6 = self._tiles6
+                rw += t6.n_rt * t6.nt * t6.tile_l * w4   # link-tile rows
+                rw += t6.n_rt * t6.tile_m * w4           # subt gathers
+                for _rt0, _rt1, plan, _o, _t in t6.groups:
+                    rw += 2 * plan.n_targets * w4
+                    rw += 2 * plan.k * w4
+                macs += d["nch"] * rk * self.nl * self.nc
+                live_macs += (
+                    t6.stats["occupied_slots"] * t6.tile_m * self.nc
+                )
+                continue
             n_t_total = int(d["n_windows"].sum())
             # every chunk executes T = max(n_windows) slots; padded
             # slots still issue their R-window dynamic_slice read (only
@@ -3005,9 +3263,10 @@ class RowPackedSaturationEngine:
             # stop being shareable across same-bucket ontologies
             fills, lroles = mk["fills"], mk["lroles"]
             s4slabs, s6slabs = mk["s4"], mk["s6"]
+            t6slabs = mk["t6"]
             m4 = m6 = t4 = t6 = None
         elif self._scan_mode:
-            fills, lroles, s4slabs, s6slabs = mk
+            fills, lroles, s4slabs, s6slabs, t6slabs = mk
             m4 = m6 = t4 = t6 = None
         else:
             m4, m6, fills, lroles, t4, t6 = mk
@@ -3282,7 +3541,7 @@ class RowPackedSaturationEngine:
                     ch |= jnp.any(cv)
                     if self._serialize_chunks:
                         sp, rp = lax.optimization_barrier((sp, rp))
-            if self._scan6 is not None:
+            if self._scan6 is not None and self._tiles6 is None:
                 dirty_l_ext = jnp.concatenate(
                     [dirty_l, jnp.zeros(1, bool)]
                 )
@@ -3306,6 +3565,92 @@ class RowPackedSaturationEngine:
                         rp, cv = gplan.write(
                             rp, red, track="rows",
                             targets=mk["g6"][gi][1] if bucket else None,
+                        )
+                    r_vecs.append(cv)
+                    ch |= jnp.any(cv)
+                    if self._serialize_chunks:
+                        sp, rp = lax.optimization_barrier((sp, rp))
+            if self._tiles6 is not None:
+                # ---- live-tile CR6 (core/cr6_tiles.py): role-run row
+                # tiles contract ONLY their densely packed live links —
+                # the [tile_m, tile_l] operand is (factored mask ∧
+                # bit-table ∧ per-link liveness), so the off-role
+                # interior the window schedule sweeps never exists.
+                # Write groups mirror the window formulation's row
+                # ranges, keeping the intra-step cascade (and per-round
+                # byte identity) intact.
+                dirty_l_ext = jnp.concatenate(
+                    [dirty_l, jnp.zeros(1, bool)]
+                )
+                mm6 = self._cr6_mm[0]
+                t6s = self._tiles6
+                rows_s, m_s, fdx_s, tids_s, tval_s = t6slabs
+                lc_g = self.lc
+
+                def tile_contract(rp_state, rt0, rt1):
+                    fd_all = dirty_l_ext[fdx_s[rt0:rt1]].any(axis=1)
+
+                    def body(_, xs):
+                        rows_k, m_k, tid_k, tva_k, fd_k = xs
+                        subt = rp_state[rows_k].T      # [width, tile_m]
+
+                        def one(t, acc):
+                            ids = tid_k[t]
+                            live = (
+                                dirty_l_ext[ids // lc_g] | fd_k
+                            ) & tva_k[t]
+                            with jax.named_scope("bit_table"):
+                                f = bit_lookup_from(
+                                    subt, fills[ids], dtype=dt
+                                )                      # [tile_l, tile_m]
+                            w = (
+                                jnp.take(
+                                    m_k, lroles[ids], axis=1
+                                ).astype(dt)
+                                * f.T
+                                * live.astype(dt)
+                            )
+                            b = rp_state[ids]          # [tile_l, width]
+                            return acc | mm6(w, b)
+
+                        z = jnp.zeros((t6s.tile_m, wlw), jnp.uint32)
+                        # nt == 0: an all-inert schedule (e.g. a cross
+                        # program whose link window satisfies no chain
+                        # role) — contribute nothing; a 0-trip
+                        # fori_loop would still trace `one` against
+                        # the empty slabs
+                        if t6s.nt == 0:
+                            acc = z
+                        elif t6s.nt == 1:
+                            acc = one(0, z)
+                        else:
+                            acc = lax.fori_loop(0, t6s.nt, one, z)
+                        return (), acc
+
+                    xs = (
+                        rows_s[rt0:rt1], m_s[rt0:rt1], tids_s[rt0:rt1],
+                        tval_s[rt0:rt1], fd_all,
+                    )
+                    _, ys = lax.scan(body, (), xs)
+                    return ys.reshape(-1, wlw)
+
+                for gi, (rt0, rt1, gplan, order_np, _tgts) in enumerate(
+                    t6s.groups
+                ):
+
+                    def red6t(r, rt0=rt0, rt1=rt1, gplan=gplan,
+                              order_np=order_np, gi=gi):
+                        out = tile_contract(r, rt0, rt1)
+                        if bucket:
+                            out = jnp.pad(out, ((0, 1), (0, 0)))
+                            return gplan.reduce(out[mk["gt6"][gi][0]])
+                        return gplan.reduce(out[jnp.asarray(order_np)])
+
+                    with jax.named_scope("cr6"):
+                        red = gated_rows(gplan.n_targets, rp, red6t)
+                        rp, cv = gplan.write(
+                            rp, red, track="rows",
+                            targets=mk["gt6"][gi][1] if bucket else None,
                         )
                     r_vecs.append(cv)
                     ch |= jnp.any(cv)

@@ -123,6 +123,10 @@ def make_engine(
         # without a blocking host sync per superstep) — the serving
         # paths run the observed loop, so this is their throughput knob
         rowpacked_kw.setdefault("pipeline", config.pipeline_config())
+        # live-tile CR6 (core/cr6_tiles.py): structure-packed
+        # role-chain join, byte-identical per round, engaged only when
+        # the live structure is sparse enough to pay
+        rowpacked_kw.setdefault("cr6_tiles", config.cr6_tiles_config())
         return RowPackedSaturationEngine(idx, **kw, **rowpacked_kw)
     if choice == "packed":
         from distel_tpu.core.packed_engine import PackedSaturationEngine

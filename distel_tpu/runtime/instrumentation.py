@@ -266,6 +266,53 @@ class FrontierAggregate:
 FRONTIER_EVENTS = FrontierAggregate()
 
 
+class StepRuleAggregate:
+    """Process-global record of the latest measured per-rule device
+    step split — the bridge from a ``runtime/profiling`` capture (the
+    only place per-rule wall exists: XLA fuses the whole superstep, so
+    host timers can't see rule boundaries) to the serve plane's
+    ``distel_step_rule_seconds{rule=...}`` gauges and the bench's
+    ``step_profile`` section.  Stores per-rule device seconds PER STEP
+    of the most recent capture plus its provenance; zeros until some
+    code in the process runs a profiled saturation (bench, a test, or
+    an operator-invoked ``profile_saturation``).  Thread-safe."""
+
+    #: phases exported as rules (the engine's named scopes; everything
+    #: else a capture reports folds into "other")
+    RULES = ("cr1", "cr2", "cr3", "cr4", "cr5", "cr6")
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self.per_rule: Dict[str, float] = {}
+        self.captures = 0
+        self.source = ""
+
+    def record(self, per_step_s: Dict[str, float], source: str = "") -> None:
+        """Fold one capture's per-step phase split in: known rule
+        scopes keep their name, the rest aggregate into ``other``."""
+        split: Dict[str, float] = {}
+        for phase, secs in per_step_s.items():
+            key = phase if phase in self.RULES else "other"
+            split[key] = split.get(key, 0.0) + float(secs)
+        with self._lock:
+            self.per_rule = split
+            self.captures += 1
+            self.source = source
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "per_rule": dict(self.per_rule),
+                "captures": self.captures,
+                "source": self.source,
+            }
+
+
+STEP_RULE_EVENTS = StepRuleAggregate()
+
+
 class CohortAggregate:
     """Process-global tally of saturation-run DEVICE DISPATCHES, split
     solo vs cohort — the instrumentation the cohort execution path's

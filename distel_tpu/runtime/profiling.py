@@ -105,11 +105,18 @@ def profile_saturation(
             shutil.rmtree(trace_dir, ignore_errors=True)
     steps = max(result.iterations, 1)
     device_total = sum(phases.values())
+    per_step = {
+        k: round(v / steps, 5) for k, v in sorted(phases.items())
+    }
+    # feed the process-global per-rule aggregate: the serve plane's
+    # distel_step_rule_seconds{rule=...} gauges export the latest
+    # measured split (runtime/instrumentation.STEP_RULE_EVENTS)
+    from distel_tpu.runtime.instrumentation import STEP_RULE_EVENTS
+
+    STEP_RULE_EVENTS.record(per_step, source="profile_saturation")
     return {
         "phases_s": {k: round(v, 4) for k, v in sorted(phases.items())},
-        "per_step_s": {
-            k: round(v / steps, 5) for k, v in sorted(phases.items())
-        },
+        "per_step_s": per_step,
         "device_total_s": round(device_total, 3),
         "wall_s": round(wall, 3),
         "host_gap_s": round(wall - device_total, 3),

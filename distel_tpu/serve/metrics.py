@@ -374,6 +374,10 @@ class Metrics:
         self._hists: Dict[str, tuple] = {}
         #: callables returning {name → value}, one call per render pass
         self._gauge_groups: list = []
+        #: name → (label_name, fn returning {label_value → value}) —
+        #: live-sampled LABELED gauge families (one label dimension,
+        #: e.g. ``distel_step_rule_seconds{rule=...}``)
+        self._labeled_gauge_fns: Dict[str, Tuple[str, Callable]] = {}
         self._help: Dict[str, str] = {}
 
     # ------------------------------------------------------------ write
@@ -407,6 +411,17 @@ class Metrics:
         render time, so the scrape always sees the current value."""
         with self._lock:
             self._gauges[name] = fn
+
+    def gauge_labeled_fn(
+        self, name: str, label: str, fn: Callable[[], Dict[str, float]]
+    ) -> None:
+        """Register a live-sampled labeled gauge family: ``fn`` returns
+        ``{label_value: value}`` and is called once per render pass, so
+        one family renders as ``name{label="k"} v`` per entry — the
+        per-rule step-attribution gauges
+        (``distel_step_rule_seconds{rule=...}``) use this."""
+        with self._lock:
+            self._labeled_gauge_fns[name] = (label, fn)
 
     def gauge_group(self, fn: Callable[[], Dict[str, float]]) -> None:
         """Register a group of live-sampled gauges: ``fn`` returns a
@@ -453,6 +468,7 @@ class Metrics:
             }
             gauges = dict(self._gauges)
             groups = list(self._gauge_groups)
+            labeled = dict(self._labeled_gauge_fns)
             hists = {
                 n: (b, {k: (list(c), s, cnt) for k, (c, s, cnt) in se.items()})
                 for n, (b, se) in sorted(self._hists.items())
@@ -481,6 +497,17 @@ class Metrics:
                 lines.append(f"# HELP {name} {escape_help(helps[name])}")
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {_fmt_value(v)}")
+        for name, (label, fn) in sorted(labeled.items()):
+            try:
+                series = {str(k): float(v) for k, v in fn().items()}
+            except Exception:  # a dying family must not kill /metrics
+                continue
+            if name in helps:
+                lines.append(f"# HELP {name} {escape_help(helps[name])}")
+            lines.append(f"# TYPE {name} gauge")
+            for k, v in sorted(series.items()):
+                lab = _fmt_labels(_labels_key({label: k}))
+                lines.append(f"{name}{lab} {_fmt_value(v)}")
         for name, (bks, series) in hists.items():
             if name in helps:
                 lines.append(f"# HELP {name} {escape_help(helps[name])}")

@@ -506,6 +506,26 @@ class ServeApp:
         for metric, _, help_text in _FRONTIER_GAUGES:
             self.metrics.describe(metric, help_text)
         self.metrics.gauge_group(_frontier_gauges)
+        # ---- per-rule step attribution (ISSUE 13): the latest
+        # measured per-rule device seconds of one superstep, from the
+        # process-global STEP_RULE_EVENTS aggregate a profiled
+        # saturation (runtime/profiling.profile_saturation — the bench
+        # step_profile section feeds it) records into.  Gauges, not
+        # counters: live-sampled last-capture values.  Absent until a
+        # capture ran in this process — a scrape then simply sees no
+        # samples for the family, which a conforming parser accepts.
+        from distel_tpu.runtime.instrumentation import STEP_RULE_EVENTS
+
+        self.metrics.describe(
+            "distel_step_rule_seconds",
+            "per-rule device seconds of one saturation superstep "
+            "(latest profiled capture; rule=cr1..cr6/other)",
+        )
+        self.metrics.gauge_labeled_fn(
+            "distel_step_rule_seconds",
+            "rule",
+            lambda: STEP_RULE_EVENTS.snapshot()["per_rule"],
+        )
         # ---- background warmup precompile: populate the program
         # registry / persistent cache for the configured buckets BEFORE
         # traffic arrives; a failure only leaves the caches cold (the

@@ -91,3 +91,25 @@ def test_failure_record_carries_partial_results(capsys):
     bench._emit_failure("bench_body", RuntimeError("x"), 1, partial={})
     rec2 = json.loads(capsys.readouterr().out.strip())
     assert "partial_results" not in rec2
+
+
+def test_run_sections_checkpoints_each_section(monkeypatch, capsys):
+    """ISSUE 13 satellite: ``--sections`` runs named sections through
+    the same child machinery, checkpointing each with a ``#partial``
+    line — a tunnel outage mid-run (the failure mode that killed the
+    r5 int8 tile probe) leaves every finished section recoverable."""
+    monkeypatch.setitem(
+        bench._SECTIONS, "stub", lambda: {"speedup": 2.0}
+    )
+    bench._run_sections(["stub", "nope"], 0.5)
+    out = capsys.readouterr().out
+    lines = out.strip().splitlines()
+    partials = bench._collect_partials(out)
+    assert partials["stub"]["speedup"] == 2.0
+    rec = json.loads(
+        next(ln for ln in reversed(lines) if ln.startswith("{"))
+    )
+    assert rec["metric"] == "bench_sections"
+    assert rec["stub"]["speedup"] == 2.0
+    assert "section_wall_s" in rec["stub"]
+    assert "unknown section" in rec["nope"]["error"]

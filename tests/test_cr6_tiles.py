@@ -1,0 +1,488 @@
+"""CR6 live-tile kernel (ISSUE 13): the structure-packed role-chain
+join of ``core/cr6_tiles.py`` + its engine wiring.
+
+The soundness claim under test: the tiled formulation's closure is
+BYTE-IDENTICAL to the scanned window formulation's *per round* — the
+tile schedule drops only operand entries the factored mask already
+zeroes (links no row of the tile can satisfy) and the write groups
+mirror the window formulation's row ranges, so the intra-step cascade
+is preserved.  Plus the interleave properties (sparse-tail and
+pipelined-controller runs with tiles match window-dense runs round for
+round), bucket-mode program sharing, the density fallback, the rebind
+fit/refusal contract, and the delta/cross fast-path parity.  The
+Pallas lowering is validated through the interpreter on CPU and runs
+for real behind the ``pallas_support`` capability guard.
+"""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+from distel_tpu.owl import parser
+
+from pallas_support import requires_pallas_mosaic
+from test_bucketing import _same_bucket_pair
+
+#: force-active tile config: the density fallback is tested separately,
+#: everything else wants the tile path exercised regardless of corpus
+TILES_ON = {"density_threshold": 100.0}
+
+
+def _indexed(text):
+    return index_ontology(normalize(parser.parse(text)))
+
+
+def _random_chain_text(seed: int, n_roles: int = 8, n_classes: int = 60):
+    """Random chain structure: a random subrole forest, random chain
+    axioms over it, random links, and ∃-on-the-left consumers — the
+    property-test corpus shape (role-sorted ``chain_pairs`` with
+    varying run lengths and live-link densities)."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for r in range(1, n_roles):
+        sup = int(rng.integers(0, r))
+        if rng.random() < 0.7:
+            lines.append(f"SubObjectPropertyOf(r{r} r{sup})")
+    n_chains = int(rng.integers(2, 6))
+    for _ in range(n_chains):
+        a, b, c = (int(x) for x in rng.integers(0, n_roles, 3))
+        lines.append(
+            f"SubObjectPropertyOf(ObjectPropertyChain(r{a} r{b}) r{c})"
+        )
+    for i in range(n_classes):
+        r = int(rng.integers(0, n_roles))
+        j = int(rng.integers(0, n_classes))
+        lines.append(
+            f"SubClassOf(C{i} ObjectSomeValuesFrom(r{r} C{j}))"
+        )
+        if rng.random() < 0.4:
+            lines.append(f"SubClassOf(C{i} C{int(rng.integers(0, n_classes))})")
+    for _ in range(n_classes // 3):
+        r = int(rng.integers(0, n_roles))
+        j = int(rng.integers(0, n_classes))
+        lines.append(
+            f"SubClassOf(ObjectSomeValuesFrom(r{r} C{j}) "
+            f"H{int(rng.integers(0, 20))})"
+        )
+    return "\n".join(lines)
+
+
+@pytest.fixture(scope="module")
+def chain_idx():
+    """Chain-heavy SNOMED shape: right-identity chains over the
+    attribute hierarchy, the structure the live-tile kernel targets."""
+    return _indexed(snomed_shaped_ontology(n_classes=600))
+
+
+def _observed(idx, *, tiles=None, sparse=None, pipeline=None):
+    engine = RowPackedSaturationEngine(
+        idx, unroll=1, bucket=True, cr6_tiles=tiles, sparse_tail=sparse,
+        pipeline=pipeline,
+    )
+    rounds = []
+    res = engine.saturate_observed(
+        observer=lambda it, d, ch: rounds.append((it, d, ch)),
+    )
+    return engine, rounds, res
+
+
+def _assert_same_closure(res_a, res_b):
+    assert np.array_equal(
+        np.asarray(res_a.packed_s), np.asarray(res_b.packed_s)
+    )
+    assert np.array_equal(
+        np.asarray(res_a.packed_r), np.asarray(res_b.packed_r)
+    )
+
+
+# --------------------------------------------- per-round golden parity
+
+
+def test_tiled_matches_window_per_round(chain_idx):
+    """THE parity fixture: window vs tiled observed runs produce
+    identical per-round (iteration, derivations, changed) sequences
+    and byte-identical closures, at matched convergence."""
+    _, win_rounds, res_w = _observed(chain_idx, tiles={"enable": False})
+    eng, til_rounds, res_t = _observed(chain_idx, tiles=TILES_ON)
+    assert eng.cr6_tiles_stats["active"], eng.cr6_tiles_stats
+    assert til_rounds == win_rounds
+    _assert_same_closure(res_w, res_t)
+    assert res_w.iterations == res_t.iterations
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_chain_structures_parity(seed):
+    """Randomized CR6 property test: random subrole forests + chain
+    axioms + role-sorted chain_pairs tables, tiled closure byte-equal
+    to window per round."""
+    idx = _indexed(_random_chain_text(seed))
+    if not len(idx.chain_pairs):
+        pytest.skip("random draw produced no chain rows")
+    _, win_rounds, res_w = _observed(idx, tiles={"enable": False})
+    eng, til_rounds, res_t = _observed(idx, tiles=TILES_ON)
+    assert til_rounds == win_rounds
+    _assert_same_closure(res_w, res_t)
+
+
+def test_public_step_parity(chain_idx):
+    """The stateless public step (all-dirty) is byte-identical too —
+    the serve plane's single-superstep entry."""
+    e_w = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True, cr6_tiles={"enable": False}
+    )
+    e_t = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True, cr6_tiles=TILES_ON
+    )
+    sp, rp = e_w.initial_state()
+    sw, rw = e_w.step(sp, rp)
+    sp2, rp2 = e_t.initial_state()
+    st, rt = e_t.step(sp2, rp2)
+    assert np.array_equal(np.asarray(sw), np.asarray(st))
+    assert np.array_equal(np.asarray(rw), np.asarray(rt))
+
+
+# ---------------------------------------- live-density sweep / fallback
+
+
+def test_no_live_links_schedule_inert():
+    """Chain roles no link can satisfy: the tile schedule is all-inert
+    and the closure still matches the window path (the rule simply
+    derives nothing)."""
+    text = (
+        # second-leg (p) links exist, so chain rows materialize; the
+        # FIRST leg q has no links, so no link can ever satisfy a row
+        "SubObjectPropertyOf(ObjectPropertyChain(q p) t)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(p B))\n"
+        "SubClassOf(B ObjectSomeValuesFrom(p C))\n"
+        "SubClassOf(ObjectSomeValuesFrom(t C) THit)\n"
+        "SubClassOf(A A2)\n"
+    )
+    idx = _indexed(text)
+    assert len(idx.chain_pairs)
+    _, win_rounds, res_w = _observed(idx, tiles={"enable": False})
+    eng, til_rounds, res_t = _observed(idx, tiles=TILES_ON)
+    assert til_rounds == win_rounds
+    _assert_same_closure(res_w, res_t)
+    if eng._tiles6 is not None:
+        assert eng.cr6_tiles_stats["live_links"] == 0
+
+
+def test_single_tile_corpus():
+    """A one-chain, few-link corpus packs into a single link tile and
+    still derives the chain completion (C r D, D r E ⊢ C r E …)."""
+    text = (
+        "SubObjectPropertyOf(ObjectPropertyChain(r r) r)\n"
+        "SubClassOf(C ObjectSomeValuesFrom(r D))\n"
+        "SubClassOf(D ObjectSomeValuesFrom(r E))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r E) Hit)\n"
+    )
+    idx = _indexed(text)
+    _, win_rounds, res_w = _observed(idx, tiles={"enable": False})
+    eng, til_rounds, res_t = _observed(idx, tiles=TILES_ON)
+    assert eng.cr6_tiles_stats["active"]
+    assert eng._tiles6.stats["live_links"] >= 1
+    assert til_rounds == win_rounds
+    _assert_same_closure(res_w, res_t)
+
+
+def test_density_threshold_falls_back_to_windows(chain_idx):
+    """Live density past the threshold: the engine quietly keeps the
+    window formulation (loudly in the stats) — the dense-fallback leg
+    of the ``cr6.tiles.density_threshold`` knob."""
+    eng = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True,
+        cr6_tiles={"density_threshold": 1e-9},
+    )
+    assert eng._tiles6 is None
+    assert not eng.cr6_tiles_stats["active"]
+    assert eng.cr6_tiles_stats["reason"] == "density above threshold"
+    # and the window engine still converges to the same closure
+    ref = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True, cr6_tiles={"enable": False}
+    )
+    _assert_same_closure(ref.saturate(), eng.saturate())
+
+
+def test_degenerate_tile_cfg_rejected(chain_idx):
+    for bad in (
+        {"tile_m": 4},
+        {"tile_l": 16},
+        {"density_threshold": 0.0},
+        {"bogus_key": 1},
+    ):
+        with pytest.raises(ValueError):
+            RowPackedSaturationEngine(
+                chain_idx, unroll=1, bucket=True, cr6_tiles=bad
+            )
+
+
+# ------------------------------ interleave parity (sparse + pipeline)
+
+
+def test_sparse_tail_interleave_parity(chain_idx):
+    """Adaptive sparse-tail runs with the tiled dense step match the
+    window-dense-only run round for round — the PR 4 suite's parity
+    claim survives the new dense formulation."""
+    _, win_rounds, res_w = _observed(chain_idx, tiles={"enable": False})
+    eng, ad_rounds, res_a = _observed(
+        chain_idx,
+        tiles=TILES_ON,
+        sparse={"density_threshold": 1.1, "hysteresis_rounds": 1},
+    )
+    assert ad_rounds == win_rounds
+    _assert_same_closure(res_w, res_a)
+    assert any(s.tier == "sparse" for s in eng.frontier_rounds)
+
+
+def test_pipelined_interleave_parity(chain_idx):
+    """Speculative pipelined rounds (PR 5) over the tiled step retire
+    byte-identically to the synchronous window loop."""
+    _, win_rounds, res_w = _observed(chain_idx, tiles={"enable": False})
+    _, pl_rounds, res_p = _observed(
+        chain_idx, tiles=TILES_ON,
+        pipeline={"enable": True, "depth": 3},
+    )
+    assert pl_rounds == win_rounds
+    _assert_same_closure(res_w, res_p)
+
+
+# ------------------------------------------- bucket-mode program purity
+
+
+def _chain_bucket_pair(shift_a=1, shift_b=3, n=96):
+    """Chain-bearing analog of test_bucketing's ``_same_bucket_pair``:
+    identical table sizes and live-link counts (so identical tile
+    rungs) with different axiom WIRING — the tripwire for any tile
+    index accidentally traced as a constant."""
+
+    def onto(shift):
+        lines = ["SubObjectPropertyOf(ObjectPropertyChain(r s) r)"]
+        for i in range(n):
+            lines.append(
+                f"SubClassOf(A{i} ObjectSomeValuesFrom(r "
+                f"B{(i + shift) % n}))"
+            )
+            lines.append(
+                f"SubClassOf(B{i} ObjectSomeValuesFrom(s "
+                f"C{(i + shift) % 16}))"
+            )
+            lines.append(
+                f"SubClassOf(ObjectSomeValuesFrom(r C{(i + shift) % 16})"
+                f" H{i % 8})"
+            )
+        return "\n".join(lines)
+
+    return onto(shift_a), onto(shift_b)
+
+
+def test_same_bucket_tiled_engines_share_program():
+    """Two same-bucket DIFFERENT ontologies resolving to the same tile
+    rungs share one compiled run program — tile indices are runtime
+    args, only the quantized counts reach the signature.  Both runs
+    must also agree with their own window formulation (the shared
+    program derives each ontology's OWN closure through the args)."""
+    text_a, text_b = _chain_bucket_pair()
+    idx_a, idx_b = _indexed(text_a), _indexed(text_b)
+    eng_a = RowPackedSaturationEngine(
+        idx_a, unroll=1, bucket=True, cr6_tiles=TILES_ON
+    )
+    eng_b = RowPackedSaturationEngine(
+        idx_b, unroll=1, bucket=True, cr6_tiles=TILES_ON
+    )
+    assert eng_a.cr6_tiles_stats["active"]
+    assert eng_b.cr6_tiles_stats["active"]
+    assert eng_a.bucket_signature == eng_b.bucket_signature
+    res_a = eng_a.saturate()
+    res_b = eng_b.saturate()
+    assert eng_b.compile_stats.program_cache_hit
+    for idx, res in ((idx_a, res_a), (idx_b, res_b)):
+        ref = RowPackedSaturationEngine(
+            idx, unroll=1, bucket=True, cr6_tiles={"enable": False}
+        ).saturate()
+        _assert_same_closure(ref, res)
+
+
+# ------------------------------------------------ rebind fit / refusal
+
+
+_REBIND_BASE = (
+    # chain rows instantiate on the s-links (second leg); the FIRST
+    # leg r starts with 4 live links, and the q-links are dead until a
+    # rebind delta makes q a subrole of r
+    "SubObjectPropertyOf(ObjectPropertyChain(r s) r)\n"
+    + "\n".join(
+        f"SubClassOf(A{i} ObjectSomeValuesFrom(r B{i}))" for i in range(4)
+    )
+    + "\n"
+    + "\n".join(
+        f"SubClassOf(B{i} ObjectSomeValuesFrom(s C{i}))" for i in range(4)
+    )
+    + "\n"
+    + "\n".join(
+        f"SubClassOf(D{i} ObjectSomeValuesFrom(q E{i}))"
+        for i in range(40)
+    )
+    + "\nSubClassOf(ObjectSomeValuesFrom(r C3) RHit)\n"
+)
+
+
+def test_rebind_refits_tiles_within_slots():
+    """A closure-growing role delta (q ⊑ s) that fits the compiled
+    tile slots rebinds in place and re-derives under the grown closure
+    — matching a fresh engine built on the new closure."""
+    idx_old = _indexed(_REBIND_BASE)
+    idx_new = _indexed(_REBIND_BASE + "SubObjectPropertyOf(q r)\n")
+    assert idx_old.n_roles == idx_new.n_roles
+    eng = RowPackedSaturationEngine(
+        idx_old, scan_chunks=True, window_headroom=2,
+        cr6_tiles=TILES_ON,
+    )
+    assert eng._tiles6 is not None
+    eng.saturate()
+    assert eng.rebind_role_closure(idx_new.role_closure)
+    res = eng.saturate()
+    fresh = RowPackedSaturationEngine(
+        idx_new, scan_chunks=True, cr6_tiles=TILES_ON
+    )
+    _assert_same_closure(fresh.saturate(), res)
+
+
+def test_rebind_refuses_on_tile_slot_overflow():
+    """The same delta against a program with NO reserve slots and a
+    tiny tile width: the grown live set needs more link tiles than the
+    compiled schedule holds — rebind must refuse, engine untouched."""
+    idx_old = _indexed(_REBIND_BASE)
+    idx_new = _indexed(_REBIND_BASE + "SubObjectPropertyOf(q r)\n")
+    eng = RowPackedSaturationEngine(
+        idx_old, scan_chunks=True, window_headroom=0,
+        cr6_tiles={"density_threshold": 100.0, "tile_l": 32},
+    )
+    assert eng._tiles6 is not None
+    before = eng._tiles6
+    if eng.rebind_role_closure(idx_new.role_closure):
+        pytest.skip("grown live set fit the quantized slots")
+    assert eng._tiles6 is before  # untouched on refusal
+
+
+# ------------------------------------------------ delta / cross parity
+
+
+def test_delta_fast_path_with_tiles_matches_rebuild():
+    """A link-creating delta over a chain base, tiles ON via config:
+    the fast path's B/cross programs (built through
+    delta_program_kwargs, which forwards cr6_tiles) converge to the
+    same closure as the tiles-off classifier."""
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.core.incremental import IncrementalClassifier
+
+    base = snomed_shaped_ontology(n_classes=300)
+    delta = "\n".join(
+        f"SubClassOf(DD{i} ObjectSomeValuesFrom(attr1 Find{i}))"
+        for i in range(6)
+    )
+
+    def run(tiles: bool):
+        cfg = ClassifierConfig(cr6_tiles=tiles)
+        inc = IncrementalClassifier(cfg)
+        inc._FAST_PATH_MIN_CONCEPTS = 0
+        inc.add_text(base)
+        res = inc.add_text(delta)
+        path = inc.history[-1]["path"]
+        return res, path
+
+    res_t, path_t = run(True)
+    res_w, path_w = run(False)
+    assert path_t == path_w == "fast"
+    _assert_same_closure(res_w, res_t)
+
+
+# --------------------------------------------- kernel / Pallas lowering
+
+
+def test_tile_matmul_interpret_matches_xla():
+    """The Mosaic tile-contraction kernel (with the per-tile skip
+    flags ``make_tile_matmul`` forces on) computes the same packed
+    AND-OR product as the XLA reference — validated on CPU through the
+    Pallas interpreter."""
+    import jax.numpy as jnp
+
+    from distel_tpu.core.cr6_tiles import make_tile_matmul
+    from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
+
+    rng = np.random.default_rng(7)
+    m, l, w = 24, 96, 8
+    a = jnp.asarray((rng.random((m, l)) < 0.07).astype(np.int8))
+    b = jnp.asarray(
+        rng.integers(0, 2**32, size=(l, w), dtype=np.uint32)
+    )
+    ref = PackedColsMatmulPlan(m, l, w, use_xla=True)(a, b)
+    kern = make_tile_matmul(
+        m, l, w,
+        {"use_xla": False, "interpret": True, "tm": 8, "tl": 32, "tw": 8},
+    )
+    assert kern.skip_zero_tiles
+    out = kern(a, b)
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+@requires_pallas_mosaic
+def test_tiled_engine_pallas_path_parity(chain_idx):
+    """Real Mosaic lowering of the tiled CR6 contraction (TPU hosts
+    only — the capability guard skips this on CPU and un-skips it the
+    moment a TPU appears): closure parity against the XLA tile path."""
+    e_xla = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True, cr6_tiles=TILES_ON,
+        mm_opts={"use_xla": True},
+    )
+    e_pal = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True, cr6_tiles=TILES_ON,
+        use_pallas=True,
+    )
+    _assert_same_closure(e_xla.saturate(), e_pal.saturate())
+
+
+# ------------------------------------------------- step-rule telemetry
+
+
+def test_step_rule_gauges_exposition():
+    """The per-rule attribution plumbing: a recorded capture renders as
+    ``distel_step_rule_seconds{rule=...}`` gauges that survive the
+    strict exposition parser."""
+    from distel_tpu.runtime.instrumentation import StepRuleAggregate
+    from distel_tpu.serve.metrics import Metrics, parse_exposition
+
+    agg = StepRuleAggregate()
+    agg.record(
+        {"cr6": 0.12, "cr1": 0.01, "bit_table_psum": 0.002},
+        source="test",
+    )
+    snap = agg.snapshot()
+    assert snap["per_rule"]["cr6"] == pytest.approx(0.12)
+    assert snap["per_rule"]["other"] == pytest.approx(0.002)
+    m = Metrics()
+    m.describe("distel_step_rule_seconds", "per-rule step seconds")
+    m.gauge_labeled_fn(
+        "distel_step_rule_seconds", "rule",
+        lambda: agg.snapshot()["per_rule"],
+    )
+    fams = parse_exposition(m.render())
+    samples = fams["distel_step_rule_seconds"]["samples"]
+    assert ("distel_step_rule_seconds", {"rule": "cr6"}, 0.12) in samples
+
+
+def test_cost_model_accounts_tiles(chain_idx):
+    """step_cost_model's live-MAC figure drops under the tile schedule
+    (the bench's before/after live-MAC fraction) while the
+    dense-equivalent denominator stays put."""
+    e_w = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True, cr6_tiles={"enable": False}
+    )
+    e_t = RowPackedSaturationEngine(
+        chain_idx, unroll=1, bucket=True, cr6_tiles=TILES_ON
+    )
+    c_w, c_t = e_w.step_cost_model(), e_t.step_cost_model()
+    assert c_t["mm_dense_equiv_macs"] == c_w["mm_dense_equiv_macs"]
+    assert c_t["mm_live_macs"] < c_w["mm_live_macs"]
