@@ -309,6 +309,12 @@ def main() -> None:
     both run as killable child processes."""
     import subprocess
 
+    # a typo'd --sections must refuse HERE, with exit code 2, before
+    # the backend probe pays up to its full retry budget — the child's
+    # rc=2 would otherwise be laundered into an exit-0 failure record
+    names = _parse_sections_argv(sys.argv[1:])
+    if names is not None:
+        _check_sections(names)
     try:
         _acquire_backend()
     except Exception as e:  # noqa: BLE001
@@ -785,7 +791,48 @@ _SECTIONS = {
 }
 
 
+def _parse_sections_argv(argv):
+    """``--sections a,b`` / ``--sections=a,b`` from an argv list;
+    None when the flag is absent (full bench run)."""
+    names = None
+    for i, a in enumerate(argv):
+        if a == "--sections":
+            # a dangling flag (value forgotten) must NOT read as "no
+            # flag -> full bench": [] fails _check_sections loudly
+            names = (
+                argv[i + 1].replace(",", " ").split()
+                if i + 1 < len(argv)
+                else []
+            )
+        elif a.startswith("--sections="):
+            names = a.split("=", 1)[1].replace(",", " ").split()
+    return names
+
+
+def _check_sections(names) -> None:
+    """Fail LOUDLY at launch on any unknown section name: a typo'd
+    `--sections cr6_tile` used to "run" an empty record and exit 0 —
+    a silent no-op that reads as a measured bench until someone opens
+    the JSON (ISSUE 14 satellite).  Called from main() BEFORE the
+    backend probe pays its retry budget, and again in the child."""
+    unknown = sorted(set(names) - set(_SECTIONS))
+    if unknown or not names:
+        print(
+            json.dumps(
+                {
+                    "error": f"unknown bench section(s): {unknown}"
+                    if unknown else "no sections named",
+                    "known_sections": sorted(_SECTIONS),
+                }
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+        raise SystemExit(2)
+
+
 def _run_sections(names, load1_start: float) -> None:
+    _check_sections(names)
     import jax
 
     from distel_tpu.config import enable_compile_cache
@@ -798,10 +845,7 @@ def _run_sections(names, load1_start: float) -> None:
         "load1_start": round(load1_start, 2),
     }
     for name in names:
-        fn = _SECTIONS.get(name)
-        if fn is None:
-            out[name] = {"error": f"unknown section {name!r}"}
-            continue
+        fn = _SECTIONS[name]
         t0 = time.time()
         out[name] = fn()
         out[name]["section_wall_s"] = round(time.time() - t0, 1)
@@ -1128,12 +1172,7 @@ if __name__ == "__main__":
         sys.argv = [sys.argv[0]] + [
             a for a in sys.argv[1:] if a != "--child"
         ]
-        names = None
-        for i, a in enumerate(list(sys.argv[1:]), start=1):
-            if a == "--sections" and i + 1 < len(sys.argv):
-                names = sys.argv[i + 1].replace(",", " ").split()
-            elif a.startswith("--sections="):
-                names = a.split("=", 1)[1].replace(",", " ").split()
+        names = _parse_sections_argv(sys.argv[1:])
         if names is not None:
             _run_sections(names, _load1())
         else:

@@ -213,6 +213,20 @@ class ClassifierConfig:
     #: scale probes, anything through ``saturate_observed`` — emit
     #: round events on traced requests regardless of this knob.)
     obs_trace_rounds: bool = False
+    #: run ledger (``distel_tpu/obs/ledger.py``): durable per-round
+    #: JSONL telemetry for observed saturations.  On, REBUILD
+    #: classifies run the observed fixed-point loop and append one
+    #: structured record per superstep round (plus open/close markers)
+    #: to a per-process ledger under ``obs_ledger_dir`` — the durable
+    #: record SCALE_r05's killed 14h run never had.  Off by default
+    #: for the same reason as ``obs_trace_rounds``: the observed
+    #: program compiles outside the bucket registry.  (Scale probes
+    #: ledger through ``scripts/scale_probe.py --ledger`` regardless
+    #: of this knob.)
+    obs_ledger: bool = False
+    #: directory rebuild ledgers land in (created on demand; one
+    #: ``rebuild-<pid>.ledger.jsonl`` per process)
+    obs_ledger_dir: str = "runs"
     #: finished-span ring capacity per process (bounded memory — a
     #: resident server traces forever without growing)
     obs_ring_capacity: int = 2048
@@ -367,6 +381,10 @@ class ClassifierConfig:
             cfg.obs_trace_rounds = (
                 raw["obs.trace_rounds"].lower() == "true"
             )
+        if "obs.ledger.enable" in raw:
+            cfg.obs_ledger = raw["obs.ledger.enable"].lower() == "true"
+        if "obs.ledger.dir" in raw:
+            cfg.obs_ledger_dir = raw["obs.ledger.dir"]
         if "obs.ring.capacity" in raw:
             cfg.obs_ring_capacity = int(raw["obs.ring.capacity"])
         if "obs.flight.capacity" in raw:

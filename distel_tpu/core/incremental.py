@@ -693,24 +693,66 @@ class IncrementalClassifier:
         from distel_tpu.obs import trace as obs_trace
 
         _sp = obs_trace.active_span()
-        if (
+        traced_rounds = (
             self.config.obs_trace_rounds
             and _sp is not None
             and _sp.sampled  # an unsampled carrier records nothing —
             # it must not pay the observed loop either
             and hasattr(engine, "saturate_observed")
-        ):
-            # traced request under obs.trace_rounds: run the observed
-            # loop (byte-identical per retired round, ~parity wall
-            # under the default pipeline — tests/test_pipeline.py pins
-            # both) so every saturation round lands as a span event on
-            # the request's trace.  Opt-in because the observed
-            # program compiles OUTSIDE the bucket registry — see the
-            # config knob's comment.
-            result = engine.saturate_observed(
-                self.config.max_iterations,
-                initial=self._pop_state(),
+        )
+        ledger_obs = None
+        if self.config.obs_ledger and hasattr(engine, "saturate_observed"):
+            # run ledger (obs.ledger.enable): the rebuild saturation
+            # emits one durable JSONL record per round — same opt-in
+            # rationale as obs.trace_rounds (the observed program
+            # compiles outside the bucket registry)
+            from distel_tpu.obs.ledger import rebuild_ledger_observer
+
+            ledger_obs = rebuild_ledger_observer(
+                self.config,
+                meta={
+                    "kind": "rebuild",
+                    "increment": self.increment,
+                    # n_classes keys the cost-model fit — without it a
+                    # rebuild ledger is dead weight in the calibration
+                    # basis (costmodel.load_ledger_observations skips it)
+                    "n_classes": int(len(idx.original_classes)),
+                    "n_concepts": idx.n_concepts,
+                    "n_links": idx.n_links,
+                },
             )
+        if traced_rounds or ledger_obs is not None:
+            # traced request under obs.trace_rounds, and/or a ledgered
+            # rebuild: run the observed loop (byte-identical per
+            # retired round, ~parity wall under the default pipeline —
+            # tests/test_pipeline.py pins both) so every saturation
+            # round lands as a span event on the request's trace and/or
+            # a ledger record.
+            kw = {}
+            if ledger_obs is not None:
+                kw["observer"] = ledger_obs.observer
+                if isinstance(engine, RowPackedSaturationEngine):
+                    # tier/density/dispatch split telemetry: only the
+                    # rowpacked controller exposes the frontier hook
+                    kw["frontier_observer"] = ledger_obs.frontier_observer
+            try:
+                result = engine.saturate_observed(
+                    self.config.max_iterations,
+                    initial=self._pop_state(),
+                    **kw,
+                )
+            except BaseException:
+                if ledger_obs is not None:
+                    ledger_obs.close("error")
+                    ledger_obs.ledger.close()
+                raise
+            if ledger_obs is not None:
+                ledger_obs.close(
+                    "converged" if result.converged else "incomplete",
+                    iterations=int(result.iterations),
+                    derivations=int(result.derivations),
+                )
+                ledger_obs.ledger.close()
         else:
             result = engine.saturate(
                 self.config.max_iterations,

@@ -12,13 +12,29 @@ Layout::
                log (heartbeat verdicts, ejections, migration stages,
                journal replays), queryable at ``/debug/events`` and
                dumped as JSONL on shutdown
+    ledger.py  RunLedger — crash-safe append-only JSONL run ledger
+               (one record per observed saturation round, plus
+               open/snapshot/resume/close chain markers), the
+               stall/regression/memory StallWatchdog, the
+               ``distel_run_*`` gauge bridge (RUN_EVENTS), and the
+               LedgerObserver adapter for ``saturate_observed``
+    costmodel.py  fitted rounds-vs-size cost model (seeded from the
+               tracked SCALE probe lines + historical ledgers), the
+               online ETA, and the launch budget guard
 
 Config knobs (``config.ClassifierConfig`` / ``obs.*`` properties):
 ``obs.enable``, ``obs.sample_rate``, ``obs.ring.capacity``,
-``obs.flight.capacity``.
+``obs.flight.capacity``, ``obs.ledger.enable``, ``obs.ledger.dir``.
 """
 
 from distel_tpu.obs.flight import FlightRecorder
+from distel_tpu.obs.ledger import (
+    RUN_EVENTS,
+    BudgetExhausted,
+    LedgerObserver,
+    RunLedger,
+    StallWatchdog,
+)
 from distel_tpu.obs.trace import (
     NOOP,
     Span,
@@ -32,8 +48,13 @@ from distel_tpu.obs.trace import (
 )
 
 __all__ = [
+    "BudgetExhausted",
     "FlightRecorder",
+    "LedgerObserver",
     "NOOP",
+    "RUN_EVENTS",
+    "RunLedger",
+    "StallWatchdog",
     "Span",
     "SpanRecorder",
     "TraceContext",

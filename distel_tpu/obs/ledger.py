@@ -1,0 +1,919 @@
+"""Run ledger: crash-safe, append-only JSONL telemetry for long
+saturation runs (stdlib only).
+
+The SCALE_r05 128k run burned 14h22m and was killed with NO durable
+record beyond ad-hoc stdout progress lines.  The ledger is the durable
+replacement — one structured record per observed superstep round plus
+run-open / snapshot / resume / run-close markers, keyed by the PR 7
+``run_id`` / ``chain_run_id`` pair so a chain of resumed sessions reads
+as ONE logical run:
+
+``open``      session start: corpus/engine meta, the fitted cost
+              model's launch prediction, the stage budget
+``resume``    this session continued from a snapshot (names the
+              writing session and carries the chain root forward)
+``round``     one retired superstep: round index (cumulative across
+              the chain), tier/density/rows_touched, per-round and
+              cumulative derivations, dispatch/retire host-time split,
+              pipeline occupancy, per-rule seconds (latest
+              ``STEP_RULE_EVENTS`` capture), host/device memory
+              high-water marks, and the online ETA re-stamped fresh
+``snapshot``  an atomic resumable snapshot landed on disk
+``anomaly``   the stall/regression/memory watchdog fired
+``close``     session end with status + predicted-vs-actual scoring
+              (a killed session simply lacks one — that absence IS the
+              crash record)
+
+Writers append one line per record and flush immediately: a SIGKILL
+can tear at most the final line, which :func:`read_ledger` tolerates
+(any OTHER malformed line is corruption and fails strict parsing).
+
+:class:`LedgerObserver` adapts the ledger to both engines'
+``saturate_observed`` hooks (``observer`` + ``frontier_observer``) —
+the scale probes, the serve plane's rebuild path (behind
+``obs.ledger.enable``), and anything else running an observed fixed
+point feed it the same way.  :data:`RUN_EVENTS` is the process-global
+bridge to the serve plane's ``distel_run_*`` gauges and the
+``/debug/runs`` endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+#: record types a valid ledger may carry
+_KNOWN_EVENTS = frozenset(
+    ("open", "resume", "round", "snapshot", "anomaly", "close")
+)
+
+
+class LedgerCorrupt(ValueError):
+    """A ledger line that is neither valid JSON nor the torn final
+    line of a killed writer."""
+
+
+class BudgetExhausted(RuntimeError):
+    """Raised out of an observed run when the in-flight stage budget is
+    spent — the caller snapshots and exits cleanly instead of being
+    killed mid-round hours later."""
+
+
+# --------------------------------------------------------------- writer
+
+
+class RunLedger:
+    """Append-only JSONL writer for one session of one run chain.
+    Thread-safe; every record carries ``run_id``, ``chain_run_id``, a
+    monotone per-session ``seq``, and a wall-clock ``ts``."""
+
+    def __init__(
+        self,
+        path: str,
+        run_id: str,
+        chain_run_id: Optional[str] = None,
+        fsync: bool = False,
+    ):
+        self.path = path
+        self.run_id = run_id
+        self.chain_run_id = chain_run_id or run_id
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        self._seq = 0
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._truncate_torn_tail(path)
+        self._f = open(path, "a", encoding="utf-8")
+
+    @staticmethod
+    def _truncate_torn_tail(path: str) -> None:
+        """Drop a predecessor's torn final line before appending.  A
+        SIGKILL mid-write leaves a partial line with no trailing
+        newline; appending this session's records straight onto it
+        would merge them into one garbled MID-file line that fails the
+        strict parse.  The fragment was never durable — the reader
+        would discard it anyway — so truncate back to the last
+        complete line."""
+        try:
+            with open(path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) == b"\n":
+                    return
+                # scan back to the last newline (bounded: one record)
+                f.seek(0)
+                data = f.read()
+                keep = data.rfind(b"\n") + 1
+                f.truncate(keep)
+        except FileNotFoundError:
+            return
+
+    def write(self, ev: str, **fields) -> dict:
+        doc = {
+            "ev": ev,
+            "run_id": self.run_id,
+            "chain_run_id": self.chain_run_id,
+            "ts": round(time.time(), 3),
+        }
+        doc.update(fields)
+        with self._lock:
+            self._seq += 1
+            doc["seq"] = self._seq
+            # serialized under the lock so seq order and file order
+            # agree even with concurrent writers
+            line = json.dumps(doc)
+            self._f.write(line + "\n")
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+        return doc
+
+    # typed record constructors — thin, but they pin the schema
+    def open_run(
+        self,
+        meta: Optional[dict] = None,
+        predicted: Optional[dict] = None,
+        budget_s: Optional[float] = None,
+    ) -> dict:
+        fields = {"schema": SCHEMA_VERSION, "meta": meta or {}}
+        if predicted is not None:
+            fields["predicted"] = predicted
+        if budget_s is not None:
+            fields["budget_s"] = float(budget_s)
+        return self.write("open", **fields)
+
+    def resume(self, **fields) -> dict:
+        return self.write("resume", **fields)
+
+    def round(self, **fields) -> dict:
+        return self.write("round", **fields)
+
+    def snapshot(self, **fields) -> dict:
+        return self.write("snapshot", **fields)
+
+    def anomaly(self, **fields) -> dict:
+        return self.write("anomaly", **fields)
+
+    def close_run(self, status: str, **fields) -> dict:
+        return self.write("close", status=status, **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# --------------------------------------------------------------- reader
+
+
+def read_ledger(path: str, strict: bool = True) -> List[dict]:
+    """Parse a ledger file.  A torn FINAL line (killed writer) is
+    dropped silently; any other malformed line raises
+    :class:`LedgerCorrupt` under ``strict`` and is skipped otherwise."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.read().split("\n")
+    # trailing "" from the final newline: every COMPLETE line ends \n
+    if lines and lines[-1] == "":
+        lines.pop()
+        torn_last = False
+    else:
+        torn_last = True
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            doc = json.loads(line)
+            if not isinstance(doc, dict) or "ev" not in doc:
+                raise ValueError("not a ledger record")
+        except ValueError:
+            if torn_last and i == len(lines) - 1:
+                continue  # crash artifact, not corruption
+            if strict:
+                raise LedgerCorrupt(
+                    f"{path}:{i + 1}: malformed ledger line: {line[:120]!r}"
+                )
+            continue
+        out.append(doc)
+    return out
+
+
+def chains(records: List[dict]) -> Dict[str, List[dict]]:
+    """Group ledger records by ``chain_run_id``, file order preserved."""
+    out: Dict[str, List[dict]] = {}
+    for rec in records:
+        out.setdefault(rec.get("chain_run_id") or "?", []).append(rec)
+    return out
+
+
+def validate_chain(records: List[dict]) -> dict:
+    """Structural validation of ONE chain's records (file order):
+
+    * the first record is ``open``; every later session starts with
+      its own ``open`` (an ``open`` arriving while the previous
+      session never closed marks that predecessor CRASHED — the
+      SIGKILL case the ledger exists for — not corruption);
+    * round indices are strictly monotone within a session, and a
+      session may only rewind PAST a crashed predecessor's tail: a
+      kill that lands after the last snapshot leaves rounds the
+      resumed session re-derives, and its re-recorded rounds
+      SUPERSEDE the crashed tail's (overlap with the same session, or
+      with a cleanly closed one, is corruption);
+    * ``close`` only ever follows that session's ``open``; nothing but
+      a new session's ``open`` follows a ``close``.
+
+    Raises ``ValueError`` on violation; returns a summary dict whose
+    round figures count the EFFECTIVE (surviving) rounds."""
+    summary, _ = _validate_chain(records)
+    return summary
+
+
+def _validate_chain(records: List[dict]) -> Tuple[dict, List[dict]]:
+    """``validate_chain`` plus the effective round records — the
+    surviving per-round sequence after crashed-tail supersede (what
+    reports, curves, and totals should be computed from)."""
+    if not records:
+        raise ValueError("empty chain")
+    if records[0].get("ev") != "open":
+        raise ValueError(
+            f"chain must start with an open record, got {records[0].get('ev')!r}"
+        )
+    # sessions are identified by their POSITION in the chain (which
+    # ``open`` they follow), never by run_id — scale_probe's --run-id
+    # legitimately pins the same id across resumed sessions
+    tagged: List[Tuple[int, dict]] = []  # (session ordinal, round rec)
+    session = -1
+    open_run: Optional[str] = None
+    closed_sessions: set = set()
+    closed_runs = crashed_runs = 0
+    snapshots = anomalies = 0
+    converged = False
+    for i, rec in enumerate(records):
+        ev = rec.get("ev")
+        if ev not in _KNOWN_EVENTS:
+            raise ValueError(f"record {i}: unknown event {ev!r}")
+        if ev == "open":
+            if open_run is not None:
+                # the previous session died without a close — exactly
+                # what a killed 14h run looks like; the resumed session
+                # appending here is the chain working as designed
+                crashed_runs += 1
+            session += 1
+            open_run = rec.get("run_id")
+            continue
+        if open_run is None:
+            raise ValueError(
+                f"record {i}: {ev!r} outside any open session"
+            )
+        if ev == "round":
+            idx = rec.get("round")
+            if not isinstance(idx, int):
+                raise ValueError(f"record {i}: round without an index")
+            while tagged and tagged[-1][1]["round"] >= idx:
+                prev_sess, prev = tagged[-1]
+                if prev_sess == session:
+                    raise ValueError(
+                        f"record {i}: round index {idx} not monotone "
+                        f"(previous {prev['round']})"
+                    )
+                if prev_sess in closed_sessions:
+                    raise ValueError(
+                        f"record {i}: round index {idx} not monotone — "
+                        f"overlaps round {prev['round']} of cleanly "
+                        f"closed session {prev.get('run_id')!r}"
+                    )
+                # the crashed predecessor recorded past its last
+                # snapshot; the resumed session re-derived this round —
+                # its record supersedes the crashed tail's
+                tagged.pop()
+            tagged.append((session, rec))
+        elif ev == "snapshot":
+            snapshots += 1
+        elif ev == "anomaly":
+            anomalies += 1
+        elif ev == "close":
+            closed_runs += 1
+            closed_sessions.add(session)
+            converged = rec.get("status") == "converged"
+            open_run = None
+    effective = [rec for _, rec in tagged]
+    summary = {
+        "runs": sum(1 for r in records if r.get("ev") == "open"),
+        "closed_runs": closed_runs,
+        "crashed_runs": crashed_runs,
+        "rounds": len(effective),
+        "last_round": effective[-1]["round"] if effective else -1,
+        "snapshots": snapshots,
+        "anomalies": anomalies,
+        "converged": converged,
+        "open_session": open_run,  # non-None = crashed/in-flight tail
+    }
+    return summary, effective
+
+
+def report_chain(records: List[dict]) -> dict:
+    """The ``cli runs report`` payload for one chain: round count,
+    derivation/completeness curve, per-rule share trend, ETA trail, and
+    predicted-vs-actual scoring — everything the SCALE_r05 postmortem
+    had to reconstruct from stdout scrollback, off one file."""
+    summary, rounds = _validate_chain(records)
+    # ``rounds`` is the EFFECTIVE sequence (crashed-tail overlap
+    # superseded by the resumed session's re-derived records), so the
+    # curve stays monotone; the wall accounting below still charges
+    # every session its raw recorded elapsed — superseded rounds were
+    # genuinely executed
+    opens = [r for r in records if r.get("ev") == "open"]
+    closes = [r for r in records if r.get("ev") == "close"]
+    curve = [
+        {
+            "round": r.get("round"),
+            "derivations_total": r.get("derivations_total"),
+            "elapsed_s": r.get("elapsed_s"),
+            "eta_s": r.get("eta_s"),
+        }
+        for r in rounds
+    ]
+    # per-rule share trend: each round carrying a rule_seconds split
+    # contributes its normalized shares; report the mean share per rule
+    share_sum: Dict[str, float] = {}
+    share_rounds = 0
+    for r in rounds:
+        rs = r.get("rule_seconds")
+        if not rs:
+            continue
+        total = sum(rs.values())
+        if total <= 0:
+            continue
+        share_rounds += 1
+        for rule, secs in rs.items():
+            share_sum[rule] = share_sum.get(rule, 0.0) + secs / total
+    rule_shares = {
+        rule: round(s / share_rounds, 4) for rule, s in share_sum.items()
+    } if share_rounds else {}
+    # chain wall: sum of per-session walls (sessions may be days apart,
+    # so last.ts - first.ts would count the gap the machine sat idle);
+    # a crashed session contributes its last recorded round's elapsed.
+    # Walked positionally, not by run_id — --run-id may pin one id
+    # across every session of the chain.
+    wall_s = 0.0
+    sess_open = False
+    sess_last_elapsed: Optional[float] = None
+    for rec in records:
+        ev = rec.get("ev")
+        if ev == "open":
+            if sess_open and sess_last_elapsed:
+                wall_s += float(sess_last_elapsed)  # crashed predecessor
+            sess_open = True
+            sess_last_elapsed = None
+        elif ev == "round" and rec.get("elapsed_s"):
+            sess_last_elapsed = rec["elapsed_s"]
+        elif ev == "close":
+            if rec.get("wall_s"):
+                wall_s += float(rec["wall_s"])
+            elif sess_last_elapsed:
+                wall_s += float(sess_last_elapsed)
+            sess_open = False
+            sess_last_elapsed = None
+    if sess_open and sess_last_elapsed:
+        wall_s += float(sess_last_elapsed)  # in-flight/crashed tail
+    out = {
+        **summary,
+        "chain_run_id": records[0].get("chain_run_id"),
+        "derivations_total": (
+            rounds[-1].get("derivations_total") if rounds else 0
+        ),
+        "wall_s": round(wall_s, 1),
+        "curve": curve,
+        "rule_shares": rule_shares,
+        "tiers": {
+            t: sum(1 for r in rounds if r.get("tier") == t)
+            for t in sorted({r.get("tier") for r in rounds if r.get("tier")})
+        },
+    }
+    # launch-prediction scoring: the FIRST session's predicted wall vs
+    # the measured chain wall
+    pred = opens[0].get("predicted") if opens else None
+    if pred and pred.get("predicted_wall_s") and wall_s > 0:
+        out["launch_prediction"] = {
+            "predicted_wall_s": pred["predicted_wall_s"],
+            "actual_wall_s": round(wall_s, 1),
+            "error": round(
+                (pred["predicted_wall_s"] - wall_s) / wall_s, 3
+            ),
+        }
+    # final ETA scoring: the last mid-run ETA stamp vs what the rest of
+    # the run actually took (closes re-score it; crashed chains keep
+    # the raw trail)
+    scored = [c.get("eta_final") for c in closes if c.get("eta_final")]
+    if scored:
+        out["eta_final"] = scored[-1]
+    return out
+
+
+# ------------------------------------------------ process-global gauges
+
+
+class RunTelemetry:
+    """Process-global run telemetry: the newest live run's per-round
+    figures (the ``distel_run_*`` gauge family samples them) plus a
+    bounded per-run summary table behind ``/debug/runs``.  Thread-safe:
+    serve rebuilds on scheduler workers and probe scripts both feed
+    it."""
+
+    _GAUGE_DEFAULTS = {
+        "round": 0.0,
+        "derivation_rate": 0.0,
+        "eta_s": -1.0,
+        "budget_remaining_s": -1.0,
+        "stall": 0.0,
+    }
+
+    def __init__(self, capacity: int = 32):
+        self._lock = threading.Lock()
+        self._runs: "deque[dict]" = deque(maxlen=capacity)
+        self._by_id: Dict[str, dict] = {}
+        self._last: Dict[str, float] = dict(self._GAUGE_DEFAULTS)
+        #: the run whose figures the gauges sample — the newest LIVE
+        #: run; an older concurrent run's update/end must not clobber
+        self._live_id: Optional[str] = None
+
+    def begin(
+        self, run_id: str, chain_run_id: str = "", meta: Optional[dict] = None
+    ) -> None:
+        rec = {
+            "run_id": run_id,
+            "chain_run_id": chain_run_id or run_id,
+            "status": "running",
+            "started_unix": round(time.time(), 3),
+            "meta": dict(meta or {}),
+            **self._GAUGE_DEFAULTS,
+        }
+        with self._lock:
+            if run_id in self._by_id:
+                self._runs.remove(self._by_id[run_id])
+            self._runs.append(rec)
+            # deque eviction: rebuild the id map from what survived
+            self._by_id = {r["run_id"]: r for r in self._runs}
+            self._live_id = run_id
+            self._last = {k: rec[k] for k in self._GAUGE_DEFAULTS}
+
+    def update(self, run_id: str, **fields) -> None:
+        with self._lock:
+            rec = self._by_id.get(run_id)
+            if rec is None:
+                return
+            for k, v in fields.items():
+                rec[k] = v
+            if run_id == self._live_id:
+                self._last = {
+                    k: float(rec.get(k, d) if rec.get(k) is not None else d)
+                    for k, d in self._GAUGE_DEFAULTS.items()
+                }
+
+    def end(self, run_id: str, status: str) -> None:
+        with self._lock:
+            rec = self._by_id.get(run_id)
+            if rec is not None:
+                rec["status"] = status
+                rec["ended_unix"] = round(time.time(), 3)
+            if run_id == self._live_id:
+                self._live_id = None
+                self._last = dict(self._GAUGE_DEFAULTS)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return {f"distel_run_{k}": v for k, v in self._last.items()}
+
+    def runs(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._runs]
+
+
+RUN_EVENTS = RunTelemetry()
+
+
+# ------------------------------------------------------------- watchdog
+
+
+class StallWatchdog:
+    """Per-run anomaly detector fed once per retired round:
+
+    * ``stall`` — ``stall_rounds`` consecutive non-terminal rounds
+      deriving nothing (the engine claims progress, the closure says
+      otherwise);
+    * ``round_wall_regression`` — a round costing more than
+      ``wall_factor`` x the rolling median (tier mis-selection, host
+      contention, a tunnel starting to black-hole);
+    * ``memory_growth`` — the host peak-RSS high-water mark rising for
+      ``mem_rounds`` consecutive rounds (a steady-state fixed point
+      should plateau; monotone growth ends in the OOM killer).
+
+    Each detection is written to the ledger, mirrored to an optional
+    flight recorder, and raised as the ``distel_run_stall`` gauge;
+    detections re-arm only after the condition clears, so a long stall
+    is one anomaly record, not thousands."""
+
+    def __init__(
+        self,
+        ledger: Optional[RunLedger] = None,
+        flight=None,
+        telemetry: Optional[RunTelemetry] = None,
+        run_id: str = "",
+        stall_rounds: int = 3,
+        wall_factor: float = 4.0,
+        min_median_s: float = 0.05,
+        mem_rounds: int = 8,
+        window: int = 16,
+    ):
+        self._ledger = ledger
+        self._flight = flight
+        self._telemetry = telemetry
+        self._run_id = run_id
+        self.stall_rounds = max(int(stall_rounds), 1)
+        self.wall_factor = float(wall_factor)
+        #: rolling medians below this never flag a regression — a
+        #: sub-50ms sparse round followed by a dense round is a tier
+        #: interleave, not a regression (the detector exists for the
+        #: 40-MINUTE rounds of SCALE_r05, not microbenchmarks)
+        self.min_median_s = float(min_median_s)
+        self.mem_rounds = max(int(mem_rounds), 2)
+        self._walls: deque = deque(maxlen=window)
+        self._zero_streak = 0
+        self._mem_streak = 0
+        self._last_mem: Optional[float] = None
+        self._active: set = set()
+        self.stalled = False
+
+    def _emit(self, kind: str, round_idx: int, **fields) -> dict:
+        ev = {"anomaly": kind, "round": round_idx, **fields}
+        if self._ledger is not None:
+            self._ledger.anomaly(**ev)
+        if self._flight is not None:
+            self._flight.record("run_anomaly", run_id=self._run_id, **ev)
+        return ev
+
+    def observe(
+        self,
+        round_idx: int,
+        deriv_delta: int,
+        changed: bool,
+        round_wall_s: float,
+        host_mb: Optional[float] = None,
+    ) -> List[dict]:
+        fired: List[dict] = []
+        # ---- non-terminal zero-derivation stall
+        if changed and deriv_delta == 0:
+            self._zero_streak += 1
+        else:
+            self._zero_streak = 0
+            self._active.discard("stall")
+        if (
+            self._zero_streak >= self.stall_rounds
+            and "stall" not in self._active
+        ):
+            self._active.add("stall")
+            fired.append(
+                self._emit(
+                    "stall", round_idx, zero_rounds=self._zero_streak
+                )
+            )
+        self.stalled = "stall" in self._active
+        # ---- round-wall regression vs the rolling median
+        if len(self._walls) >= 3 and round_wall_s > 0:
+            import statistics
+
+            med = statistics.median(self._walls)
+            if (
+                med >= self.min_median_s
+                and round_wall_s > self.wall_factor * med
+            ):
+                if "wall" not in self._active:
+                    self._active.add("wall")
+                    fired.append(
+                        self._emit(
+                            "round_wall_regression",
+                            round_idx,
+                            round_wall_s=round(round_wall_s, 3),
+                            rolling_median_s=round(med, 3),
+                            factor=round(round_wall_s / med, 1),
+                        )
+                    )
+            else:
+                self._active.discard("wall")
+        if round_wall_s > 0:
+            self._walls.append(round_wall_s)
+        # ---- monotone host-memory growth (peak RSS keeps climbing)
+        if host_mb is not None:
+            if self._last_mem is not None and host_mb > self._last_mem:
+                self._mem_streak += 1
+            elif self._last_mem is not None:
+                self._mem_streak = 0
+                self._active.discard("mem")
+            self._last_mem = host_mb
+            if (
+                self._mem_streak >= self.mem_rounds
+                and "mem" not in self._active
+            ):
+                self._active.add("mem")
+                fired.append(
+                    self._emit(
+                        "memory_growth",
+                        round_idx,
+                        host_mb=round(host_mb, 1),
+                        growth_rounds=self._mem_streak,
+                    )
+                )
+        if self._telemetry is not None:
+            self._telemetry.update(
+                self._run_id, stall=1.0 if self.stalled else 0.0
+            )
+        return fired
+
+
+# ------------------------------------------------ memory high-water marks
+
+
+def host_peak_mb() -> Optional[float]:
+    """Host peak RSS in MiB (``ru_maxrss`` — kilobytes on Linux, bytes
+    on macOS); None where the resource module is unavailable."""
+    try:
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        if sys.platform == "darwin":
+            return peak / (1 << 20)
+        return peak / 1024.0
+    except Exception:
+        return None
+
+
+def device_peak_mb() -> Optional[float]:
+    """Accelerator peak bytes in use, when the backend reports memory
+    stats (TPU/GPU; the CPU backend answers None).  Lazy jax import so
+    the obs package stays stdlib-importable."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            peak = stats.get("peak_bytes_in_use") or stats.get(
+                "bytes_in_use"
+            )
+            if peak is not None:
+                return float(peak) / (1 << 20)
+    except Exception:
+        pass
+    return None
+
+
+# ------------------------------------------ the saturate_observed adapter
+
+
+class LedgerObserver:
+    """Bundles the ``observer`` / ``frontier_observer`` callback pair
+    both engines' ``saturate_observed`` accepts into one ledger-writing
+    unit: per retired round it assembles the full round record (tier
+    telemetry when the adaptive controller supplies it, per-rule
+    seconds from the latest ``STEP_RULE_EVENTS`` capture, memory
+    high-water marks, the freshly re-stamped online ETA), appends it,
+    feeds the watchdog, and updates :data:`RUN_EVENTS`.
+
+    ``budget_s``: in-flight stage budget — once total elapsed exceeds
+    it the observer raises :class:`BudgetExhausted` AFTER recording the
+    round (callers with a ``state_observer`` snapshot first; see
+    ``scripts/scale_probe.py``).  The per-round cost is one dict build
+    + one flushed line write — measured <=1% of a warm classify's wall
+    (the acceptance bound this module ships under)."""
+
+    def __init__(
+        self,
+        ledger: RunLedger,
+        *,
+        model=None,
+        n_for_model: Optional[int] = None,
+        budget_s: Optional[float] = None,
+        budget_spent_s: float = 0.0,
+        base_iters: int = 0,
+        base_derivs: int = 0,
+        flight=None,
+        telemetry: Optional[RunTelemetry] = RUN_EVENTS,
+        watchdog: Optional[StallWatchdog] = None,
+        track_device_mem: bool = True,
+        raise_on_budget: bool = True,
+    ):
+        from distel_tpu.obs.costmodel import OnlineEta
+
+        self.ledger = ledger
+        self.base_iters = int(base_iters)
+        self.base_derivs = int(base_derivs)
+        self.budget_s = budget_s
+        self._budget_spent = float(budget_spent_s)
+        self._raise_on_budget = raise_on_budget
+        self.budget_exhausted = False
+        self._telemetry = telemetry
+        self._track_device_mem = track_device_mem
+        self._eta = OnlineEta(model=model, n=n_for_model)
+        self.watchdog = (
+            watchdog
+            if watchdog is not None
+            else StallWatchdog(
+                ledger=ledger,
+                flight=flight,
+                telemetry=telemetry,
+                run_id=ledger.run_id,
+            )
+        )
+        self._t0 = time.perf_counter()
+        self._last_t = self._t0
+        self._prev_derivs = 0
+        self._rule_captures = -1
+        self._rule_seconds: Optional[dict] = None
+        self._st = None  # FrontierStats stash (rowpacked engines only)
+        self.last_eta_s: Optional[float] = None
+        self.last_elapsed_s = 0.0
+        self.last_iteration = 0
+        self.last_derivations = 0
+        self.rounds = 0
+        if telemetry is not None:
+            telemetry.begin(
+                ledger.run_id,
+                chain_run_id=ledger.chain_run_id,
+                meta={"ledger": ledger.path},
+            )
+
+    # the two callables saturate_observed takes; frontier_observer runs
+    # first for a given iteration in both controllers
+    def frontier_observer(self, st) -> None:
+        self._st = st
+
+    def _rule_split(self) -> Optional[dict]:
+        """Latest per-rule per-step seconds, refreshed only when a new
+        profiling capture landed (the snapshot costs a lock)."""
+        try:
+            from distel_tpu.runtime.instrumentation import STEP_RULE_EVENTS
+        except Exception:
+            return None
+        snap = STEP_RULE_EVENTS.snapshot()
+        if snap["captures"] != self._rule_captures:
+            self._rule_captures = snap["captures"]
+            self._rule_seconds = (
+                {k: round(v, 6) for k, v in snap["per_rule"].items()}
+                if snap["per_rule"]
+                else None
+            )
+        return self._rule_seconds
+
+    def observer(self, iteration: int, derivations: int, changed: bool):
+        now = time.perf_counter()
+        round_wall = now - self._last_t
+        self._last_t = now
+        elapsed = now - self._t0
+        self.last_elapsed_s = elapsed
+        self.rounds += 1
+        delta = int(derivations) - self._prev_derivs
+        self._prev_derivs = int(derivations)
+        self.last_iteration = int(iteration)
+        self.last_derivations = int(derivations)
+        round_total = self.base_iters + int(iteration)
+        eta_s, remaining = self._eta.update(round_wall, delta)
+        self.last_eta_s = eta_s
+        host_mb = host_peak_mb()
+        fields = {
+            "round": round_total,
+            "iteration": int(iteration),
+            "derivations": delta,
+            "derivations_total": self.base_derivs + int(derivations),
+            "changed": bool(changed),
+            "round_wall_s": round(round_wall, 4),
+            "elapsed_s": round(elapsed, 3),
+        }
+        st = self._st
+        if st is not None and st.iteration == iteration:
+            fields.update(
+                tier=st.tier,
+                density=round(st.density, 5),
+                rows_touched=st.rows_touched,
+                dispatch_s=round(st.dispatch_s, 4),
+                retire_s=round(st.retire_s, 4),
+                inflight=st.inflight,
+            )
+        if eta_s is not None:
+            fields["eta_s"] = round(eta_s, 1)
+            fields["eta_rounds_remaining"] = remaining
+        if host_mb is not None:
+            fields["host_mb"] = round(host_mb, 1)
+        if self._track_device_mem:
+            dev_mb = device_peak_mb()
+            if dev_mb is not None:
+                fields["device_mb"] = round(dev_mb, 1)
+        rule_seconds = self._rule_split()
+        if rule_seconds:
+            fields["rule_seconds"] = rule_seconds
+        budget_remaining = None
+        if self.budget_s is not None:
+            budget_remaining = self.budget_s - self._budget_spent - elapsed
+            fields["budget_remaining_s"] = round(budget_remaining, 1)
+        self.watchdog.observe(
+            round_total, delta, bool(changed), round_wall, host_mb
+        )
+        self.ledger.round(**fields)
+        if self._telemetry is not None:
+            self._telemetry.update(
+                self.ledger.run_id,
+                round=float(round_total),
+                derivation_rate=(
+                    delta / round_wall if round_wall > 0 else 0.0
+                ),
+                eta_s=eta_s,
+                budget_remaining_s=budget_remaining,
+            )
+        if (
+            budget_remaining is not None
+            and budget_remaining <= 0
+            and changed
+        ):
+            # ``raise_on_budget=False`` only FLAGS here: callers with a
+            # state_observer persist this round's snapshot first, then
+            # raise themselves (the observer runs before the
+            # state_observer in both engines' loops)
+            self.budget_exhausted = True
+            if self._raise_on_budget:
+                raise BudgetExhausted(
+                    f"stage budget {self.budget_s:.0f}s exhausted at "
+                    f"round {round_total} ({elapsed:.0f}s this session)"
+                )
+
+    def close(self, status: str, **fields) -> dict:
+        """Write the close record, scoring the last in-flight ETA
+        against what the tail actually took."""
+        elapsed = time.perf_counter() - self._t0
+        doc = {
+            "iterations": self.rounds,
+            "wall_s": round(elapsed, 3),
+            **fields,
+        }
+        if self.last_eta_s is not None:
+            # the ETA stamped at the LAST round predicted the remaining
+            # tail; with the run over, the truth of that tail is known
+            actual_tail = elapsed - self.last_elapsed_s
+            doc["eta_final"] = {
+                "predicted_tail_s": round(self.last_eta_s, 1),
+                "actual_tail_s": round(actual_tail, 1),
+                "error_s": round(self.last_eta_s - actual_tail, 1),
+            }
+        rec = self.ledger.close_run(status, **doc)
+        if self._telemetry is not None:
+            self._telemetry.end(self.ledger.run_id, status)
+        return rec
+
+
+# ------------------------------------------------ serve-plane integration
+
+_REBUILD_SEQ = [0]
+_REBUILD_LOCK = threading.Lock()
+
+
+def rebuild_ledger_observer(config, meta: Optional[dict] = None):
+    """The serve/classify rebuild path's ledger hook (behind the
+    ``obs.ledger.enable`` knob): opens (or appends to) the per-process
+    rebuild ledger under ``obs.ledger.dir`` and returns a
+    :class:`LedgerObserver` whose ``close()`` the caller owes after
+    the run.  Returns None when the knob is off or the dir is
+    unwritable (telemetry must never fail a classify)."""
+    if not getattr(config, "obs_ledger", False):
+        return None
+    try:
+        with _REBUILD_LOCK:
+            _REBUILD_SEQ[0] += 1
+            seq = _REBUILD_SEQ[0]
+        run_id = "rebuild-{}-{:x}-{}".format(
+            time.strftime("%Y%m%dT%H%M%S"), os.getpid(), seq
+        )
+        path = os.path.join(
+            config.obs_ledger_dir or "runs",
+            "rebuild-{:x}.ledger.jsonl".format(os.getpid()),
+        )
+        ledger = RunLedger(path, run_id)
+        ledger.open_run(meta=meta or {})
+        return LedgerObserver(ledger, track_device_mem=False)
+    except OSError:
+        return None

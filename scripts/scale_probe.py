@@ -118,6 +118,33 @@ def _parse_args(argv=None):
                          "of resumed scale runs correlate across "
                          "sessions in the trace tooling (default: a "
                          "fresh time+pid id per launch)")
+    ap.add_argument("--ledger", default=None,
+                    help="run-ledger JSONL path (default: "
+                         "<out>.ledger.jsonl when --out is set): one "
+                         "crash-safe structured record per observed "
+                         "round plus open/snapshot/resume/close chain "
+                         "markers — the durable telemetry SCALE_r05's "
+                         "killed 14h run never had; a resumed run "
+                         "APPENDS to the same file so the chain reads "
+                         "as one logical run (`cli runs report`)")
+    ap.add_argument("--stage-budget-s", type=float, default=None,
+                    help="stage wall budget: at launch the fitted cost "
+                         "model (obs/costmodel.py, seeded from the "
+                         "tracked SCALE probe lines + historical "
+                         "ledgers) predicts the wall and the launch is "
+                         "REFUSED when the prediction exceeds this; "
+                         "in flight, exhausting it writes an atomic "
+                         "resumable snapshot and exits cleanly instead "
+                         "of being killed mid-round")
+    ap.add_argument("--force", action="store_true",
+                    help="launch past a failed --stage-budget-s guard "
+                         "(the in-flight budget still applies)")
+    ap.add_argument("--model-from", nargs="*", default=None,
+                    metavar="FILE",
+                    help="probe/ledger files the cost model fits from "
+                         "(default: the repo's SCALE_r0*_probes.jsonl "
+                         "+ runs/*.ledger.jsonl + this run's --ledger "
+                         "history)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.resume_from and not args.execute:
@@ -149,7 +176,66 @@ def main() -> None:
     run_probe(args)
 
 
+def _close_ledger(
+    ledger, ledger_obs, flight, ledger_path, status, **fields
+) -> None:
+    """Close this session's ledger chain segment and drop the flight
+    JSONL next to it when the watchdog recorded anything."""
+    if ledger is None:
+        return
+    ledger_obs.close(status, **fields)
+    ledger.close()
+    if flight is not None and flight.events():
+        try:
+            flight.dump(ledger_path + ".flight.jsonl")
+        except OSError:
+            pass
+
+
 def run_probe(args) -> None:
+    t_proc = time.time()
+    # ledger path resolves before anything heavy: it feeds the launch
+    # guard's calibration basis AND decides the observed mode below
+    ledger_path = args.ledger or (
+        args.out + ".ledger.jsonl" if args.out else None
+    )
+    if ledger_path is None and args.stage_budget_s is not None:
+        # the IN-FLIGHT budget stop rides the ledger observer; without
+        # a ledger the flag would silently degrade to launch-guard-only
+        # — the blind-overrun failure mode it exists to prevent
+        if args.progress_file:
+            ledger_path = args.progress_file + ".ledger.jsonl"
+        else:
+            print(
+                "warning: --stage-budget-s without --out/--ledger/"
+                "--progress arms only the LAUNCH guard; pass --ledger "
+                "to get the in-flight budget stop",
+                file=sys.stderr, flush=True,
+            )
+    # ---- launch budget guard (ISSUE 14): fit the cost model from the
+    # historical record and refuse an over-budget predicted launch
+    # BEFORE any jax import, corpus build, or compile is paid — the
+    # refusal costs milliseconds, the run it prevents costs a stage.
+    model = None
+    if args.stage_budget_s is not None or ledger_path:
+        from distel_tpu.obs import costmodel
+
+        basis = args.model_from
+        if basis is None:
+            basis = costmodel.default_basis_paths(_REPO)
+            if ledger_path and os.path.exists(ledger_path):
+                basis.append(ledger_path)
+        model = costmodel.fit_from_paths(basis)
+        if args.stage_budget_s is not None:
+            guard = costmodel.guard_launch(
+                model, args.n_classes, args.stage_budget_s,
+                force=args.force,
+            )
+            # the basis is the argument FOR the refusal — print it
+            print(json.dumps({"launch_guard": guard}), flush=True)
+            if not guard["allowed"]:
+                raise SystemExit("refusing launch: " + guard["reason"])
+
     import jax
     import numpy as np
 
@@ -229,7 +315,9 @@ def run_probe(args) -> None:
             "--snapshot-every needs a snapshot path: pass --snapshot "
             "or --out"
         )
-    will_observe = bool(args.execute and (progress or want_snap))
+    will_observe = bool(
+        args.execute and (progress or want_snap or ledger_path)
+    )
     # the sparse tier rides the scanned CR4/CR6 formulation (pinned
     # bit-identical to the unrolled one by tests/test_scan_engine.py);
     # at SNOMED scale scan mode auto-engages anyway, so forcing it here
@@ -351,8 +439,58 @@ def run_probe(args) -> None:
                 "derivations": base_derivs,
                 "load_s": round(time.time() - t0, 1),
             }
+        # ---- run ledger (ISSUE 14): the durable per-round record of
+        # this session, appended to the CHAIN's ledger file (a resumed
+        # run reuses the same path, so `cli runs report` reads the
+        # whole chain from one file).  The flight recorder catches the
+        # watchdog's anomaly events; its JSONL lands next to the
+        # ledger at close when anything fired.
+        ledger = ledger_obs = flight = None
+        if ledger_path:
+            from distel_tpu.obs.flight import FlightRecorder
+            from distel_tpu.obs.ledger import LedgerObserver, RunLedger
+
+            flight = FlightRecorder(service="scale_probe")
+            ledger = RunLedger(
+                ledger_path, run_id, chain_run_id=chain_run_id
+            )
+            ledger.open_run(
+                meta={
+                    k: rec[k]
+                    for k in (
+                        "n_classes", "shape", "devices", "backend",
+                        "n_concepts", "n_links", "bucket_signature",
+                    )
+                    if k in rec
+                },
+                predicted=(
+                    model.describe(args.n_classes)
+                    if model is not None
+                    else None
+                ),
+                budget_s=args.stage_budget_s,
+            )
+            if args.resume_from:
+                ledger.resume(**rec["resumed_from"])
+            ledger_obs = LedgerObserver(
+                ledger,
+                model=model,
+                n_for_model=args.n_classes,
+                budget_s=args.stage_budget_s,
+                # launch work (index/build/AOT/resume-load) already
+                # spent part of the stage budget
+                budget_spent_s=time.time() - t_proc,
+                base_iters=base_iters,
+                base_derivs=base_derivs,
+                flight=flight,
+                # with snapshotting on, exhaustion FLAGS so the
+                # state_observer persists this round first (see below)
+                raise_on_budget=not want_snap,
+            )
+            rec["ledger"] = ledger_path
         t0 = time.time()
-        if progress or want_snap:
+        budget_stop = None
+        if progress or want_snap or ledger_path:
             # observed fixed point: one host sync per superstep round
             # (noise next to the multi-hour virtual-mesh step walls)
             # buys a durable per-iteration record and/or resumable
@@ -364,6 +502,7 @@ def run_probe(args) -> None:
             # for a pure-execution figure
             first_round = []
             observer = None
+            progress_observer = None
             # per-round frontier stats from the adaptive controller
             # (tier chosen, density, rows touched) — merged into the
             # progress lines so a probe record shows WHICH rounds ran
@@ -372,6 +511,8 @@ def run_probe(args) -> None:
 
             def frontier_observer(st):
                 frontier_box[0] = st
+                if ledger_obs is not None:
+                    ledger_obs.frontier_observer(st)
 
             if progress:
                 with open(progress, "a") as f:
@@ -380,7 +521,7 @@ def run_probe(args) -> None:
                         **rec,
                     }) + "\n")
 
-                def observer(iteration, derivations, changed):
+                def progress_observer(iteration, derivations, changed):
                     if not first_round:
                         first_round.append(round(time.time() - t0, 1))
                     line = {
@@ -405,6 +546,16 @@ def run_probe(args) -> None:
                     with open(progress, "a") as f:
                         f.write(json.dumps(line) + "\n")
 
+            if progress_observer is not None or ledger_obs is not None:
+                def observer(iteration, derivations, changed):
+                    if progress_observer is not None:
+                        progress_observer(iteration, derivations, changed)
+                    if ledger_obs is not None:
+                        # writes the ledger round record, updates the
+                        # ETA/watchdog, and — without a state_observer
+                        # — raises BudgetExhausted on a spent budget
+                        ledger_obs.observer(iteration, derivations, changed)
+
             state_observer = None
             if want_snap:
                 from distel_tpu.core.engine import SaturationResult
@@ -416,9 +567,19 @@ def run_probe(args) -> None:
                 def state_observer(iteration, derivations, changed, sp, rp):
                     # every K rounds, plus unconditionally at convergence
                     # (the converged closure is the artifact the next
-                    # round's containment / taxonomy work wants)
+                    # round's containment / taxonomy work wants) and on
+                    # budget exhaustion (the observer flagged it this
+                    # round; persist the state, THEN stop cleanly)
                     rounds_seen[0] += 1
-                    if changed and rounds_seen[0] % snap_every:
+                    budget_hit = bool(
+                        ledger_obs is not None
+                        and ledger_obs.budget_exhausted
+                        and changed
+                    )
+                    if (
+                        changed and not budget_hit
+                        and rounds_seen[0] % snap_every
+                    ):
                         return
                     ts = time.time()
                     try:
@@ -436,6 +597,15 @@ def run_probe(args) -> None:
                                         f"{type(e).__name__}: {e}"[:300],
                                     "iteration": int(iteration),
                                 }) + "\n")
+                    if budget_hit:
+                        from distel_tpu.obs.ledger import BudgetExhausted
+
+                        raise BudgetExhausted(
+                            f"stage budget {args.stage_budget_s:.0f}s "
+                            f"exhausted at iteration "
+                            f"{base_iters + int(iteration)}; resumable "
+                            f"snapshot at {snap_path}"
+                        )
 
                 def _write_snapshot(
                     iteration, derivations, changed, ts, sp, rp
@@ -460,6 +630,15 @@ def run_probe(args) -> None:
                         },
                     )
                     os.replace(snap_tmp, snap_path)
+                    if ledger is not None:
+                        ledger.snapshot(
+                            path=snap_path,
+                            iteration_total=base_iters + int(iteration),
+                            derivations_total=(
+                                base_derivs + int(derivations)
+                            ),
+                            snapshot_s=round(time.time() - ts, 1),
+                        )
                     if progress:
                         with open(progress, "a") as f:
                             f.write(json.dumps({
@@ -472,12 +651,21 @@ def run_probe(args) -> None:
                                 "snapshot_s": round(time.time() - ts, 1),
                             }) + "\n")
 
-            result = engine.saturate_observed(
-                observer=observer,
-                state_observer=state_observer,
-                initial=snap_state,
-                frontier_observer=frontier_observer,
-            )
+            from distel_tpu.obs.ledger import BudgetExhausted
+
+            try:
+                result = engine.saturate_observed(
+                    observer=observer,
+                    state_observer=state_observer,
+                    initial=snap_state,
+                    frontier_observer=frontier_observer,
+                )
+            except BudgetExhausted as e:
+                # the clean exit the 14h22m kill never got: the round
+                # that spent the budget is recorded (and snapshotted,
+                # when snapshotting is on) — resume with --resume-from
+                result = None
+                budget_stop = str(e)
             rec["observed_mode"] = True
             if first_round:
                 # ≈ observed-program compile + one superstep round; the
@@ -507,6 +695,36 @@ def run_probe(args) -> None:
         else:
             result = engine.saturate(initial=snap_state)
         rec["exec_wall_s"] = round(time.time() - t0, 1)
+        if budget_stop is not None:
+            # budget-exhausted clean exit: record what the session DID
+            # retire, close the ledger with the honest status, and
+            # skip convergence-dependent work (oracle containment
+            # needs the full closure)
+            rec["budget_exhausted"] = True
+            rec["budget_stop"] = budget_stop
+            rec["converged"] = False
+            rec["iterations"] = ledger_obs.last_iteration
+            rec["derivations"] = ledger_obs.last_derivations
+            rec["iterations_total"] = (
+                base_iters + ledger_obs.last_iteration
+            )
+            rec["derivations_total"] = (
+                base_derivs + ledger_obs.last_derivations
+            )
+            _close_ledger(
+                ledger, ledger_obs, flight, ledger_path,
+                "budget_exhausted",
+                iterations=rec["iterations"],
+                derivations=rec["derivations"],
+                iterations_total=rec["iterations_total"],
+                derivations_total=rec["derivations_total"],
+            )
+            line = json.dumps(rec)
+            print(line, flush=True)
+            if args.out:
+                with open(args.out, "a") as f:
+                    f.write(line + "\n")
+            return
         rec["iterations"] = int(result.iterations)
         rec["derivations"] = int(result.derivations)
         if args.resume_from:
@@ -515,6 +733,14 @@ def run_probe(args) -> None:
             rec["derivations_total"] = base_derivs + int(result.derivations)
             rec["iterations_total"] = base_iters + int(result.iterations)
         rec["converged"] = bool(result.converged)
+        _close_ledger(
+            ledger, ledger_obs, flight, ledger_path,
+            "converged" if result.converged else "incomplete",
+            iterations=int(result.iterations),
+            derivations=int(result.derivations),
+            iterations_total=base_iters + int(result.iterations),
+            derivations_total=base_derivs + int(result.derivations),
+        )
 
         if args.oracle_budget > 0:
             from distel_tpu.core import oracle as cpu_oracle

@@ -101,7 +101,7 @@ def test_run_sections_checkpoints_each_section(monkeypatch, capsys):
     monkeypatch.setitem(
         bench._SECTIONS, "stub", lambda: {"speedup": 2.0}
     )
-    bench._run_sections(["stub", "nope"], 0.5)
+    bench._run_sections(["stub"], 0.5)
     out = capsys.readouterr().out
     lines = out.strip().splitlines()
     partials = bench._collect_partials(out)
@@ -112,4 +112,55 @@ def test_run_sections_checkpoints_each_section(monkeypatch, capsys):
     assert rec["metric"] == "bench_sections"
     assert rec["stub"]["speedup"] == 2.0
     assert "section_wall_s" in rec["stub"]
-    assert "unknown section" in rec["nope"]["error"]
+
+
+def test_run_sections_fails_loudly_on_unknown_name(monkeypatch, capsys):
+    """ISSUE 14 satellite: an unknown ``--sections`` name must refuse
+    the whole run at launch (exit 2, known-section list on stderr) —
+    not record an error blob and exit 0 as if something was measured."""
+    ran = []
+    monkeypatch.setitem(
+        bench._SECTIONS, "stub", lambda: ran.append(1) or {"ok": 1}
+    )
+    with pytest.raises(SystemExit) as exc:
+        bench._run_sections(["stub", "nope"], 0.5)
+    assert exc.value.code == 2
+    assert ran == []  # nothing ran: the typo is caught before work
+    err = capsys.readouterr().err
+    doc = json.loads(err.strip().splitlines()[-1])
+    assert "nope" in doc["error"]
+    assert "cr6_tiles" in doc["known_sections"]
+    # the empty list is equally loud (the silent-no-op regression)
+    with pytest.raises(SystemExit):
+        bench._run_sections([], 0.5)
+
+
+def test_main_refuses_unknown_sections_before_backend_probe(
+    monkeypatch, capsys
+):
+    """The TOP-LEVEL entry must refuse a typo'd --sections with exit
+    code 2 before the backend probe pays its retry budget — the child
+    wrapper used to launder the child's rc=2 into an exit-0 failure
+    record."""
+    def _no_probe(*_a, **_k):
+        raise AssertionError("backend probe paid before validation")
+
+    monkeypatch.setattr(bench, "_acquire_backend", _no_probe)
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--sections", "stub,nope"]
+    )
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 2
+    doc = json.loads(capsys.readouterr().err.strip().splitlines()[-1])
+    assert "nope" in doc["error"]
+    # both spellings parse identically
+    assert bench._parse_sections_argv(["--sections=a,b"]) == ["a", "b"]
+    assert bench._parse_sections_argv(["--out", "x.json"]) is None
+    # a DANGLING --sections (value forgotten) must refuse, not silently
+    # run the full multi-hour bench
+    assert bench._parse_sections_argv(["--sections"]) == []
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--sections"])
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 2

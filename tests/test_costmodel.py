@@ -1,0 +1,164 @@
+"""Cost model + probe-line back-compat (ISSUE 14).
+
+The tracked ``SCALE_r04_probes.jsonl`` / ``SCALE_r05_probes.jsonl``
+files are the calibration seed of the first fitted model — the loader
+must parse every line VERBATIM as committed, across the three vintages
+they accumulated (flat compile probes, flat exec records incl. resumed
+tails, the r04 component-partitioned record with a nested ``exec``
+block).  Stdlib-only: none of this imports jax.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from distel_tpu.obs import costmodel as cm
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_R04 = os.path.join(_REPO, "SCALE_r04_probes.jsonl")
+_R05 = os.path.join(_REPO, "SCALE_r05_probes.jsonl")
+
+
+def test_tracked_r04_probe_lines_parse_verbatim():
+    obs = cm.load_probe_lines(_R04)
+    # 4 committed lines: 3 flat 300k compile probes + the
+    # component-partitioned 300k execution (nested exec block)
+    assert len(obs) == 4
+    kinds = sorted(o.kind for o in obs)
+    assert kinds == ["compile", "compile", "compile", "partitioned"]
+    part = next(o for o in obs if o.kind == "partitioned")
+    assert part.n == 300000
+    assert part.rounds == 16
+    assert part.wall_s == pytest.approx(140.959)
+    for o in obs:
+        if o.kind == "compile":
+            assert o.compile_s and o.compile_s > 0
+
+
+def test_tracked_r05_probe_lines_parse_verbatim():
+    obs = cm.load_probe_lines(_R05)
+    # 5 committed lines: 4 compile probes (300k x2, 128k, 200k) + the
+    # resumed 64k galen execution (tail iterations/wall pairing)
+    assert len(obs) == 5
+    ex = [o for o in obs if o.kind == "exec"]
+    assert len(ex) == 1
+    assert ex[0].n == 64000
+    assert ex[0].rounds == 10  # the post-resume tail, NOT the 20 total
+    assert ex[0].wall_s == pytest.approx(5166.4)
+    assert ex[0].s_per_round == pytest.approx(516.64)
+    assert sorted(o.n for o in obs if o.kind == "compile") == [
+        128000, 200000, 300000, 300000,
+    ]
+
+
+def test_tracked_files_seed_a_model_that_refuses_the_r05_launch():
+    """The acceptance narrative end to end: fitted on the committed
+    history, the model predicts the 128k run CANNOT fit a 5-10 h band
+    (SCALE_r05 burned 14h22m before the kill) — the guard refuses, and
+    ``force`` overrides."""
+    model = cm.fit_from_paths([_R04, _R05])
+    assert model is not None
+    # the single exec point anchors the default exponents: ~34 min
+    # rounds at 128k, matching the observed ~40 min
+    spr_128k = model.predict_seconds_per_round(128000)
+    assert 1500 < spr_128k < 2500
+    guard = cm.guard_launch(model, 128000, budget_s=5 * 3600)
+    assert guard["fits"] is False and guard["allowed"] is False
+    assert "basis" in guard and guard["basis"]
+    forced = cm.guard_launch(model, 128000, budget_s=5 * 3600, force=True)
+    assert forced["allowed"] is True and forced["fits"] is False
+
+
+def test_guard_without_a_model_allows_and_says_why():
+    guard = cm.guard_launch(None, 128000, budget_s=60.0)
+    assert guard["allowed"] is True
+    assert "basis" in guard["reason"] or "observation" in guard["reason"]
+
+
+def test_power_fit_regresses_past_two_distinct_sizes():
+    # exact power law y = 3 * x^1.5 must be recovered, ignoring the
+    # anchored default exponent entirely
+    pts = [(10.0, 3 * 10**1.5), (100.0, 3 * 100**1.5), (40.0, 3 * 40**1.5)]
+    coef, exp = cm._fit_power(pts, default_exp=9.9)
+    assert exp == pytest.approx(1.5, rel=1e-6)
+    assert coef == pytest.approx(3.0, rel=1e-6)
+
+
+def test_single_point_anchors_default_exponent():
+    coef, exp = cm._fit_power([(64000.0, 516.0)], cm.DEFAULT_SPR_EXP)
+    assert exp == cm.DEFAULT_SPR_EXP
+    assert coef * 64000.0**exp == pytest.approx(516.0)
+
+
+def test_fit_uses_only_executed_observations():
+    obs = [
+        cm.ProbeObs(n=1000, kind="compile", source="x", compile_s=9.0),
+        cm.ProbeObs(n=2000, kind="partitioned", source="x", rounds=4,
+                    wall_s=1.0),
+    ]
+    assert cm.fit_cost_model(obs) is None
+    obs.append(
+        cm.ProbeObs(n=4000, kind="exec", source="x", rounds=10, wall_s=50.0)
+    )
+    model = cm.fit_cost_model(obs)
+    assert model is not None
+    assert len(model.basis) == 1 and model.basis[0]["n_classes"] == 4000
+
+
+def test_online_eta_geometric_tail():
+    eta = cm.OnlineEta()
+    # growth phase: no tail estimate, no model -> honestly unknown
+    assert eta.update(1.0, 100) == (None, None)
+    assert eta.update(1.0, 200) == (None, None)
+    # clean geometric decay (ratio 0.5): remaining ~ log2(last delta)
+    e = None
+    for d in (400, 200, 100, 50):
+        e, remaining = eta.update(2.0, d)
+    assert e is not None and remaining is not None
+    # walls are all 2.0 s -> eta = 2.0 * remaining
+    assert e == pytest.approx(2.0 * remaining)
+    assert 4 <= remaining <= 10  # log2(50) ~ 5.6 rounds to drain
+
+
+def test_online_eta_model_fallback_while_growing():
+    model = cm.CostModel(
+        rounds_coef=1.0, rounds_exp=0.0, spr_coef=0.0, spr_exp=0.0
+    )
+    model.rounds_coef = 20.0  # predict_rounds == 20 for any n
+    eta = cm.OnlineEta(model=model, n=1000)
+    e, remaining = eta.update(3.0, 100)
+    assert remaining == 19  # 20 predicted - 1 retired
+    assert e == pytest.approx(3.0 * 19)
+
+
+def test_default_basis_env_override(monkeypatch, tmp_path):
+    monkeypatch.setenv("DISTEL_COSTMODEL_BASIS", "/x/a.jsonl:/x/b.jsonl")
+    assert cm.default_basis_paths() == ["/x/a.jsonl", "/x/b.jsonl"]
+    monkeypatch.delenv("DISTEL_COSTMODEL_BASIS")
+    # default: the tracked probe files at the repo root + runs/ ledgers
+    paths = cm.default_basis_paths(_REPO)
+    assert _R04 in paths and _R05 in paths
+
+
+def test_ledger_files_feed_the_basis(tmp_path):
+    """A completed run's ledger is itself calibration signal — the
+    gather layer sniffs ledger-format files and extracts per-session
+    exec observations."""
+    from distel_tpu.obs.ledger import RunLedger
+
+    p = tmp_path / "x.ledger.jsonl"
+    led = RunLedger(str(p), "r1")
+    led.open_run(meta={"n_classes": 5000})
+    for i in range(1, 4):
+        led.round(round=i, iteration=i, derivations=10, elapsed_s=float(i))
+    led.close_run("converged", iterations=3, wall_s=30.0)
+    led.close()
+    obs = cm.gather_observations([str(p), _R05])
+    mine = [o for o in obs if o.n == 5000]
+    assert len(mine) == 1
+    assert mine[0].kind == "exec"
+    assert mine[0].rounds == 3 and mine[0].wall_s == pytest.approx(30.0)
+    # the probe file rode along through the same entry point
+    assert any(o.n == 64000 for o in obs)

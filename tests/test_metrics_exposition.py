@@ -5,6 +5,7 @@ characters, and the STRICT exposition parser that guards the router's
 aggregated /metrics page against regressions a real scraper would
 reject."""
 
+import json
 import math
 
 import pytest
@@ -195,3 +196,52 @@ def test_serve_app_metrics_page_parses_strictly():
         assert fams["distel_request_phase_seconds"]["type"] == "summary"
     finally:
         app.close(final_spill=False)
+
+
+def test_serve_app_renders_run_family_with_step_rule_gauges():
+    """ISSUE 14 satellite: a live ServeApp /metrics page renders the
+    run-observatory ``distel_run_*`` family AND the PR 13
+    ``distel_step_rule_seconds{rule=}`` labeled gauges together, and
+    the whole page still survives the strict exposition parser."""
+    import distel_tpu.runtime.instrumentation as instr
+    from distel_tpu.obs.ledger import RUN_EVENTS
+    from distel_tpu.runtime.instrumentation import StepRuleAggregate
+    from distel_tpu.serve.server import ServeApp
+
+    agg = StepRuleAggregate()
+    agg.record({"cr1": 0.1, "cr6": 0.4, "embed": 0.05}, source="test")
+    old = instr.STEP_RULE_EVENTS
+    instr.STEP_RULE_EVENTS = agg
+    RUN_EVENTS.begin("expo-run", meta={})
+    RUN_EVENTS.update(
+        "expo-run", round=7.0, derivation_rate=123.0, eta_s=42.0,
+        budget_remaining_s=600.0, stall=1.0,
+    )
+    app = ServeApp(fast_path_min_concepts=0)
+    try:
+        status, _ctype, payload = app._ep_metrics(
+            query={}, body=b"", deadline_s=None
+        )
+        assert status == 200
+        fams = parse_exposition(payload.decode())
+        # the run family, live-sampled from RUN_EVENTS
+        assert fams["distel_run_round"]["samples"] == [
+            ("distel_run_round", {}, 7.0)
+        ]
+        assert fams["distel_run_eta_s"]["samples"][0][2] == 42.0
+        assert fams["distel_run_budget_remaining_s"]["samples"][0][2] == 600.0
+        assert fams["distel_run_stall"]["samples"][0][2] == 1.0
+        assert fams["distel_run_derivation_rate"]["type"] == "gauge"
+        # ...next to the per-rule step attribution family
+        samples = fams["distel_step_rule_seconds"]["samples"]
+        assert ("distel_step_rule_seconds", {"rule": "cr6"}, 0.4) in samples
+        assert ("distel_step_rule_seconds", {"rule": "other"}, 0.05) in samples
+        # /debug/runs serves the same telemetry's per-run summaries
+        s, _ct, pl = app._ep_debug_runs(query={}, body=b"", deadline_s=None)
+        assert s == 200
+        runs = json.loads(pl)["runs"]
+        assert any(r["run_id"] == "expo-run" for r in runs)
+    finally:
+        app.close(final_spill=False)
+        instr.STEP_RULE_EVENTS = old
+        RUN_EVENTS.end("expo-run", "done")
