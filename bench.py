@@ -392,18 +392,11 @@ def _sparse_tail_probe(n_classes: int = 4000, chain_depth: int = 28) -> dict:
     indices — and a byte-identity verdict on the final closures."""
     import numpy as np
 
-    from distel_tpu.frontend.ontology_tools import synthetic_ontology as synth
+    from distel_tpu.frontend.ontology_tools import chain_tailed_ontology
 
-    text = synth(
-        n_classes=n_classes, n_anatomy=n_classes // 10,
-        n_locations=n_classes // 12, n_definitions=n_classes // 20,
+    idx = index_ontology(
+        normalize(parser.parse(chain_tailed_ontology(n_classes, chain_depth)))
     )
-    text += "\n" + "\n".join(
-        f"SubClassOf(TailChain{i} TailChain{i + 1})"
-        for i in range(chain_depth)
-    )
-    text += "\nSubClassOf(Class0 TailChain0)"
-    idx = index_ontology(normalize(parser.parse(text)))
 
     def observed(engine, sparse):
         walls, last = [], [time.time()]
@@ -517,17 +510,11 @@ def _pipeline_probe(n_classes: int = 2000, chain_depth: int = 24) -> dict:
     import jax
     import numpy as np
 
-    from distel_tpu.frontend.ontology_tools import synthetic_ontology as synth
+    from distel_tpu.frontend.ontology_tools import chain_tailed_ontology
 
-    text = synth(
-        n_classes=n_classes, n_anatomy=n_classes // 10,
-        n_locations=n_classes // 12, n_definitions=n_classes // 20,
+    idx = index_ontology(
+        normalize(parser.parse(chain_tailed_ontology(n_classes, chain_depth)))
     )
-    text += "\n" + "\n".join(
-        f"SubClassOf(TailChain{i} TailChain{i + 1})"
-        for i in range(chain_depth)
-    )
-    idx = index_ontology(normalize(parser.parse(text + "\nSubClassOf(Class0 TailChain0)")))
     engine = RowPackedSaturationEngine(idx, bucket=True, unroll=1)
     engine.saturate()  # warm the fused program
 
@@ -779,15 +766,200 @@ def _cr6_tiles_probe(n_classes: int = 4000) -> dict:
     return rec
 
 
+def _sharded_saturation_inner(
+    n_classes: int = 1200, chain_depth: int = 16
+) -> dict:
+    """The measurement body of the ``sharded_saturation`` section —
+    requires a backend already holding >= 4 devices (virtual CPU mesh
+    or real chips).  Grid: {1, 2, 4} word-axis shards x {dense
+    synchronous, sparse-tail, pipelined depth 2/4} observed adaptive
+    runs on a chain-tailed GALEN shape, interleaved repetitions so
+    outside load drifts cancel.  Every cell's final closure is
+    digest-compared — the MULTICHIP A/B's closure-identity half — and
+    the pipelined cells record their dispatch/retire host-time split
+    (the per-shard deferred-fold overlap ISSUE 15 ports to the mesh
+    path).  On a CPU host the record is about CORRECTNESS + dispatch
+    accounting, not speedup: virtual shards serialize on the host's
+    cores (the caveat field says so in-record)."""
+    import hashlib
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from distel_tpu.core.engine import fetch_global
+    from distel_tpu.frontend.ontology_tools import chain_tailed_ontology
+
+    idx = index_ontology(
+        normalize(parser.parse(chain_tailed_ontology(n_classes, chain_depth)))
+    )
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            f"sharded_saturation needs >= 4 devices, found {len(devs)}"
+        )
+    modes = {
+        "dense_sync": dict(
+            sparse_tail={"enable": False}, pipeline={"enable": False}
+        ),
+        "sparse_tail": dict(
+            sparse_tail=True, pipeline={"enable": False}
+        ),
+        "pipelined_d2": dict(
+            sparse_tail=True, pipeline={"enable": True, "depth": 2}
+        ),
+        "pipelined_d4": dict(
+            sparse_tail=True, pipeline={"enable": True, "depth": 4}
+        ),
+    }
+    engines = {}
+    for shards in (1, 2, 4):
+        mesh = (
+            None
+            if shards == 1
+            else jax.sharding.Mesh(np.array(devs[:shards]), ("c",))
+        )
+        engines[shards] = RowPackedSaturationEngine(
+            idx, bucket=True, unroll=1, mesh=mesh
+        )
+
+    def run(shards, mode):
+        eng = engines[shards]
+        t0 = time.time()
+        res = eng.saturate_observed(**modes[mode])
+        wall = time.time() - t0
+        return wall, res, list(eng.frontier_rounds)
+
+    digests = {}
+    cells = {s: {m: {"walls": []} for m in modes} for s in engines}
+    # warm every cell (compiles + registry fills), then record the
+    # closure digest and telemetry from a WARM pass — the cold pass's
+    # dispatch_s would be dominated by the cell's program compiles
+    for shards in engines:
+        for mode in modes:
+            run(shards, mode)
+            _w, res, frs = run(shards, mode)
+            ps, pr = fetch_global((res.packed_s, res.packed_r))
+            digests[(shards, mode)] = hashlib.sha256(
+                np.asarray(ps).tobytes() + np.asarray(pr).tobytes()
+            ).hexdigest()
+            c = cells[shards][mode]
+            c["rounds"] = int(res.iterations)
+            c["sparse_rounds"] = sum(
+                1 for s in frs if s.tier == "sparse"
+            )
+            c["dispatch_s"] = round(sum(s.dispatch_s for s in frs), 4)
+            c["retire_s"] = round(sum(s.retire_s for s in frs), 4)
+            c["speculative_rounds"] = sum(
+                1 for s in frs if s.inflight > 0
+            )
+    # interleaved timed reps: cell order inside each rep, reps outermost
+    for _rep in range(3):
+        for shards in engines:
+            for mode in modes:
+                cells[shards][mode]["walls"].append(run(shards, mode)[0])
+    uniq = set(digests.values())
+    out_shards = {}
+    for shards in engines:
+        row = {}
+        for mode in modes:
+            c = cells[shards][mode]
+            row[mode] = {
+                "wall_s": round(statistics.median(c["walls"]), 3),
+                "rounds": c["rounds"],
+                "sparse_rounds": c["sparse_rounds"],
+                "dispatch_s": c["dispatch_s"],
+                "retire_s": c["retire_s"],
+                "speculative_rounds": c["speculative_rounds"],
+            }
+        ds = row["dense_sync"]["wall_s"]
+        row["vs_dense_sync"] = {
+            m: round(ds / row[m]["wall_s"], 2)
+            for m in modes
+            if row[m]["wall_s"] > 0
+        }
+        out_shards[str(shards)] = row
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or -1
+    return {
+        "corpus": f"galen_shaped_{n_classes}_chain{chain_depth}",
+        "n_concepts": idx.n_concepts,
+        "platform": devs[0].platform,
+        "schedulable_cores": cores,
+        "host_caveat": (
+            "virtual CPU mesh: all shards execute on the host's "
+            f"{cores} schedulable core(s), so N-shard walls include "
+            "full serialization of per-shard work plus collective "
+            "overhead — the closure-identity and dispatch/retire "
+            "accounting are the portable result; shard-scaling walls "
+            "need real chips"
+        ),
+        "closure_identical": len(uniq) == 1,
+        "closure_digest": next(iter(uniq)) if len(uniq) == 1 else None,
+        "digests": {
+            f"{s}x:{m}": d for (s, m), d in sorted(digests.items())
+        } if len(uniq) != 1 else None,
+        "shards": out_shards,
+    }
+
+
+def _sharded_saturation_probe() -> dict:
+    """Dense vs sparse-tail vs pipelined adaptive saturation on 1/2/4
+    virtual word-axis shards (ISSUE 15) — the MULTICHIP_r06 feeder.
+    The measurement needs >= 4 devices; when this process's backend
+    has fewer (the usual bench environment: one real chip or one CPU
+    device), the body re-execs in a subprocess pinned to a 4-device
+    virtual CPU mesh — the same recipe scale_probe and the multichip
+    dryrun use — and relays its record."""
+    import subprocess
+
+    from distel_tpu.testing.cpumesh import cpu_mesh_env, initialized_devices
+
+    # an ALREADY-INITIALIZED backend with >= 4 devices of ANY platform
+    # measures inline: the virtual CPU mesh (the pytest/conftest case)
+    # or a real 4+-chip host — the latter is the record this section
+    # ultimately wants, without the serialization caveat.  An
+    # uninitialized backend is never probed (touching jax.devices()
+    # cold would initialize the axon tunnel chip — see cpumesh).
+    if len(initialized_devices()) >= 4:
+        return _sharded_saturation_inner()
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharded-inner"],
+        env=cpu_mesh_env(4),
+        capture_output=True,
+        text=True,
+        timeout=3000,
+    )
+    sys.stderr.write(p.stderr or "")
+    line = next(
+        (
+            ln
+            for ln in reversed((p.stdout or "").splitlines())
+            if ln.startswith("{")
+        ),
+        None,
+    )
+    if p.returncode != 0 or not line:
+        raise RuntimeError(
+            f"sharded_saturation child rc={p.returncode}: "
+            f"{(p.stderr or '')[-300:]}"
+        )
+    return json.loads(line)
+
+
 #: named bench sections runnable standalone via ``--sections a,b`` —
 #: each still goes through main()'s probe/retry/partial machinery, so
 #: a CPU host (or a half-up tunnel) can produce a BENCH record of just
 #: the sections it can afford (BENCH_r06.json is the cr6_tiles section
-#: run this way)
+#: run this way; MULTICHIP_r06.json is the sharded_saturation section)
 _SECTIONS = {
     "cr6_tiles": _cr6_tiles_probe,
     "sparse_tail": _sparse_tail_probe,
     "pipelined_observed": _pipeline_probe,
+    "sharded_saturation": _sharded_saturation_probe,
 }
 
 
@@ -1168,6 +1340,16 @@ def _run_bench(load1_start: float) -> None:
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         _probe_backend_once()
+    elif "--sharded-inner" in sys.argv:
+        # the sharded_saturation measurement body, re-exec'd into a
+        # process whose env pins a 4-device virtual CPU mesh (see
+        # _sharded_saturation_probe); prints exactly one JSON line
+        from distel_tpu.config import enable_compile_cache
+        from distel_tpu.testing.cpumesh import force_cpu_mesh
+
+        force_cpu_mesh(4)
+        enable_compile_cache()
+        print(json.dumps(_sharded_saturation_inner()))
     elif "--child" in sys.argv:
         sys.argv = [sys.argv[0]] + [
             a for a in sys.argv[1:] if a != "--child"

@@ -65,7 +65,10 @@ def cmd_classify(args) -> int:
         model = costmodel.fit_from_paths(
             args.model_from
             if args.model_from is not None
-            else costmodel.default_basis_paths(repo_root)
+            else costmodel.default_basis_paths(repo_root),
+            # dimension the fit on this launch's mesh shape: 1-shard
+            # and N-shard seconds-per-round points never silently pool
+            shards=cfg.mesh_devices or 1,
         )
         n = ontology_stats(args.ontology)["classes"]
         guard = costmodel.guard_launch(

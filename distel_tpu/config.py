@@ -133,7 +133,9 @@ class ClassifierConfig:
     #: enable_compile_cache default under ~/.cache/distel_tpu)
     compile_cache_dir: Optional[str] = None
     #: adaptive sparse-tail execution (rowpacked engine, observed runs,
-    #: single device): when a round's frontier density drops below
+    #: single-device and mesh — the sparse program builds in the same
+    #: shard_map structure as the dense step): when a round's frontier
+    #: density drops below
     #: ``sparse_density_threshold``, the controller switches from the
     #: dense step program to a frontier-compacted sparse step that
     #: gathers only the active rule rows/chunks into a small

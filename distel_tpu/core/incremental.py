@@ -719,6 +719,10 @@ class IncrementalClassifier:
                     "n_classes": int(len(idx.original_classes)),
                     "n_concepts": idx.n_concepts,
                     "n_links": idx.n_links,
+                    # mesh shape keys the cost-model fit's shards
+                    # dimension: 1-shard and N-shard seconds-per-round
+                    # points must never silently mix in one basis
+                    "n_shards": int(getattr(engine, "n_shards", 1) or 1),
                 },
             )
         if traced_rounds or ledger_obs is not None:

@@ -57,6 +57,7 @@ from distel_tpu.core.engine import (
 )
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
 from distel_tpu.ops.bitmatmul import PackedMatmulPlan
+from distel_tpu.parallel.shard_compat import shard_map
 from distel_tpu.ops.bitpack import (
     ColumnScatter,
     gather_bit_columns,
@@ -364,7 +365,7 @@ class PackedSaturationEngine:
             return sp, rp, it[None], changed[None], bits
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 run,
                 mesh=self.mesh,
                 in_specs=(P(axis, None), P(axis, None)),

@@ -89,6 +89,7 @@ from distel_tpu.core.program_cache import (
     signature_of,
 )
 from distel_tpu.ops.bitmatmul import PackedColsMatmulPlan
+from distel_tpu.parallel.shard_compat import shard_map
 from distel_tpu.ops.bitpack import (
     SegmentedRowOr,
     bit_lookup,
@@ -1861,7 +1862,7 @@ class RowPackedSaturationEngine:
             else (state, state, masks)
         )
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 fn,
                 mesh=self.mesh,
                 in_specs=in_specs,
@@ -1870,6 +1871,12 @@ class RowPackedSaturationEngine:
             ),
             donate_argnums=donate,
         )
+
+    def _shard_word_base(self, axis_name):
+        """This shard's word offset into the packed word axis (the ONE
+        place the even-split layout invariant — ``wc`` divisible by
+        ``n_shards``, arranged by construction padding — is encoded)."""
+        return lax.axis_index(axis_name) * (self.wc // self.n_shards)
 
     def _bit_table(
         self, p: jax.Array, rows: np.ndarray, axis_name: Optional[str],
@@ -1892,7 +1899,7 @@ class RowPackedSaturationEngine:
             cols = self._fillers
         if axis_name is None:
             return bit_lookup(p, rows, cols, dtype=dt)
-        base = lax.axis_index(axis_name) * (self.wc // self.n_shards)
+        base = self._shard_word_base(axis_name)
         bits = bit_lookup(p, rows, cols, word_offset=base, dtype=jnp.int32)
         return lax.psum(bits, axis_name).astype(dt)
 
@@ -2094,12 +2101,13 @@ class RowPackedSaturationEngine:
         return cfg
 
     def _sparse_supported(self) -> bool:
-        """The tier's support matrix: single device (the sharded
-        sparse tier is the ROADMAP multichip follow-up), and CR4/CR6 —
-        when present — in the scanned-chunk formulation (the sparse
-        program rides their slabs; bucket mode always scans)."""
-        if self.mesh is not None:
-            return False
+        """The tier's support matrix: CR4/CR6 — when present — must be
+        in the scanned-chunk formulation (the sparse program rides
+        their slabs; bucket mode always scans).  Mesh engines are
+        supported: the sparse program builds inside the same shard_map
+        structure as the dense step (see :meth:`_sparse_aot`), so the
+        adaptive controller — including pipelined dense dispatch —
+        drives single-device and sharded engines identically."""
         if (self._has4 or self._has6) and not self._scan_mode:
             return False
         return True
@@ -2358,7 +2366,7 @@ class RowPackedSaturationEngine:
             )
         return sa
 
-    def _sparse_exec(self, sp, rp, sa):
+    def _sparse_exec(self, sp, rp, sa, axis_name=None):
         """One frontier-compacted superstep — the sparse tier's traced
         program.  Rule order and intra-step read/write structure mirror
         :meth:`_step` verbatim (CR1 → CR2 → CR3 → CR4 groups in dense
@@ -2376,9 +2384,27 @@ class RowPackedSaturationEngine:
         any_r, dirty_l_next)`` — the frontier fold the host controller
         carries into the next round; ``delta_bits`` counts new
         live-column bits so tail rounds skip the full live-bits sweep.
-        Single-device only."""
+
+        With ``axis_name`` the body runs inside the mesh engines'
+        shard_map structure (see :meth:`_sparse_aot`): state arrives as
+        the shard-local word window, the compacted row gathers/writes
+        stay shard-local (row indices address every shard's full row
+        axis), the CR4/CR6/CR5 bit-table lookups use the dense step's
+        masked-local-extract + ``psum`` exchange, and the round's
+        frontier fold (changed vote, delta popcount, changed-row masks)
+        is ``psum``-folded ONCE at the end — the per-round analog of
+        the fixed point's AND-vote, so the host controller reads one
+        replicated fold regardless of mesh size."""
         width = sp.shape[1]
         wmask = sa["wmask"]
+        base = None
+        if axis_name is not None:
+            # shard-local views: `base` is this shard's word offset
+            # (all column/word bookkeeping below is in word units),
+            # `wmask` narrows to the local window so the delta popcount
+            # counts each live bit on exactly one shard
+            base = self._shard_word_base(axis_name)
+            wmask = lax.dynamic_slice(wmask, (base,), (width,))
         dt = self.matmul_dtype
         delta = jnp.asarray(0, jnp.int32)
         changed = jnp.asarray(False)
@@ -2467,7 +2493,7 @@ class RowPackedSaturationEngine:
                     return acc | _window_term(
                         subt, rp_state, sa["fills"], sa["lroles"],
                         offs_k[i], live, m_k[None], mm, lcn, dt,
-                        width,
+                        width, axis_name, base,
                     )
 
                 z = jnp.zeros((1, width), jnp.uint32)
@@ -2517,8 +2543,10 @@ class RowPackedSaturationEngine:
 
             def red5(ops):
                 s, r = ops
-                botf = bit_lookup(
-                    s, np.full(1, BOTTOM_ID), sa["fills"], dtype=dt
+                # same masked-local-extract + psum exchange as the
+                # dense step's ⊥-filler mask (see _bit_table)
+                botf = self._bit_table(
+                    s, np.full(1, BOTTOM_ID), axis_name, cols=sa["fills"]
                 )
                 bmask = botf[:, 0].astype(bool)
                 masked = jnp.where(
@@ -2549,6 +2577,18 @@ class RowPackedSaturationEngine:
                 changed = changed | chg
 
         with jax.named_scope("frontier"):
+            if axis_name is not None:
+                # ONE per-round exchange folds every shard's view of
+                # the frontier (a row's new bits may land on a single
+                # shard's word window): the changed vote, the delta
+                # popcount partials, and the changed-row masks leave
+                # replicated — the sharded analog of the reference's
+                # per-iteration barrier read, paid once per round
+                # instead of once per rule
+                changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
+                delta = lax.psum(delta, axis_name)
+                mask_s = lax.psum(mask_s.astype(jnp.int32), axis_name) > 0
+                mask_r = lax.psum(mask_r.astype(jnp.int32), axis_name) > 0
             any_r = jnp.any(mask_r)
             dirty_l_next = mask_r.reshape(
                 self.n_lchunks, self.lc
@@ -2572,12 +2612,36 @@ class RowPackedSaturationEngine:
         sp_av = jax.ShapeDtypeStruct((self.nc, self.wc), jnp.uint32)
         rp_av = jax.ShapeDtypeStruct((self.nl, self.wc), jnp.uint32)
         sa_av = self._sparse_avals(c123, a4, a6)
+        if self.mesh is None:
+            fn = jax.jit(self._sparse_exec, donate_argnums=(0, 1))
+        else:
+            # the mesh variant runs the SAME body inside the same
+            # shard_map structure as the dense step: state sharded on
+            # the packed word axis, the compacted workspace arguments
+            # replicated (they are row indices + tiny masks — byte-
+            # scale next to the state), and every output replicated by
+            # the body's end-of-round psum fold, so the host controller
+            # is mesh-agnostic (out_specs P() hand it the same scalars/
+            # masks the single-device program returns)
+            P = jax.sharding.PartitionSpec
+            axis = self.word_axis
+            state = P(None, axis)
+            fn = jax.jit(
+                shard_map(
+                    functools.partial(self._sparse_exec, axis_name=axis),
+                    mesh=self.mesh,
+                    in_specs=(
+                        state, state, jax.tree.map(lambda _: P(), sa_av)
+                    ),
+                    out_specs=(state, state, P(), P(), P(), P(), P()),
+                    check_vma=False,
+                ),
+                donate_argnums=(0, 1),
+            )
 
         def build():
             t0 = time.perf_counter()
-            lowered = jax.jit(
-                self._sparse_exec, donate_argnums=(0, 1)
-            ).lower(sp_av, rp_av, sa_av)
+            lowered = fn.lower(sp_av, rp_av, sa_av)
             t1 = time.perf_counter()
             compiled = lowered.compile()
             stats.trace_lower_s = t1 - t0
@@ -3417,7 +3481,7 @@ class RowPackedSaturationEngine:
         base = (
             None
             if axis_name is None
-            else lax.axis_index(axis_name) * (self.wc // self.n_shards)
+            else self._shard_word_base(axis_name)
         )
 
         def window_term(subt, rp_state, off, live, mask_rows, mm, lcw):
@@ -3784,9 +3848,10 @@ class RowPackedSaturationEngine:
         if wmask is None:
             wmask = jnp.asarray(self._wmask)
         if axis_name is not None:
-            wpl = self.wc // self.n_shards
             wmask = lax.dynamic_slice(
-                wmask, (lax.axis_index(axis_name) * wpl,), (wpl,)
+                wmask,
+                (self._shard_word_base(axis_name),),
+                (self.wc // self.n_shards,),
             )
         bs = jnp.sum(
             lax.population_count(sp & wmask[None, :]), axis=1, dtype=jnp.int32
@@ -3969,8 +4034,18 @@ class RowPackedSaturationEngine:
         self, cfg, sp, rp, init_total, budget, observer, state_observer,
         frontier_observer, pipeline_depth: int = 1,
     ):
-        """The dense/sparse controller loop (single device), with
-        pipelined dense dispatch.  Per retired round: measure density
+        """The dense/sparse controller loop, with pipelined dense
+        dispatch.  Runs single-device and mesh engines identically:
+        the dense rounds go through the (shard_map-structured, on a
+        mesh) ``_observe_jit`` and the sparse rounds through the
+        matching ``_sparse_aot`` program, both of which hand back
+        replicated folds — so the host logic below never branches on
+        the mesh.  On a mesh the deferred per-shard frontier folds are
+        where pipelining pays most: each retire's host fold replaces a
+        per-round all-shard sync (the reference's per-iteration Redis
+        barrier, ``controller/CommunicationHandler.java:78-83``,
+        multiplied by shards), overlapped behind the next speculative
+        round's device execution.  Per retired round: measure density
         from the frontier the round consumed, track hysteresis, and
         pick the tier — dense (the regular ``unroll``-step observed
         round) above ``density_threshold`` or on workspace overflow;
@@ -4226,7 +4301,7 @@ class RowPackedSaturationEngine:
                     sp, rp, ch_d, delta_d, ms_d, ar_d, dl_d = exe(
                         sp, rp, self._sparse_args(plan)
                     )
-                    ch, delta, s_chg, ar, dirty_l = jax.device_get(
+                    ch, delta, s_chg, ar, dirty_l = fetch_global(
                         (ch_d, delta_d, ms_d, ar_d, dl_d)
                     )
                     any_r = bool(ar)

@@ -21,7 +21,7 @@ Equivalents of the reference's corpus tooling:
 from __future__ import annotations
 
 import random
-from typing import List
+from typing import List, Optional
 
 from distel_tpu.owl import syntax as S
 
@@ -66,6 +66,44 @@ def synthetic_ontology(
             f"ObjectSomeValuesFrom(hasLoc Anat{a})))"
         )
     return "\n".join(lines)
+
+
+def chain_tailed_ontology(
+    n_classes: int,
+    chain_depth: int,
+    *,
+    n_anatomy: Optional[int] = None,
+    n_locations: Optional[int] = None,
+    n_definitions: Optional[int] = None,
+    seed: int = 42,
+) -> str:
+    """:func:`synthetic_ontology` plus a ``SubClassOf`` chain tail
+    (``TailChain0 ⊑ … ⊑ TailChain{chain_depth}``, anchored by
+    ``Class0 ⊑ TailChain0``) — the adaptive sparse tier's regime:
+    late saturation rounds derive exactly one chain hop each, so the
+    frontier density collapses while the fixed point keeps running.
+    THE shared corpus recipe of the sparse-tail / pipelined / sharded
+    A/B probes and their parity tests — one definition so every
+    consumer measures the same regime.  Dimension defaults follow the
+    GALEN shape (``n//10`` anatomy, ``n//12`` locations, ``n//20``
+    definitions)."""
+    text = synthetic_ontology(
+        n_classes=n_classes,
+        n_anatomy=n_anatomy if n_anatomy is not None else n_classes // 10,
+        n_locations=(
+            n_locations if n_locations is not None else n_classes // 12
+        ),
+        n_definitions=(
+            n_definitions if n_definitions is not None else n_classes // 20
+        ),
+        seed=seed,
+    )
+    text += "\n" + "\n".join(
+        f"SubClassOf(TailChain{i} TailChain{i + 1})"
+        for i in range(chain_depth)
+    )
+    text += "\nSubClassOf(Class0 TailChain0)"
+    return text
 
 
 def snomed_shaped_ontology(

@@ -70,6 +70,12 @@ class ProbeObs:
     #: would systematically under-predict round counts (and walls)
     rounds_total: Optional[int] = None
     compile_s: Optional[float] = None
+    #: mesh shape of the run (1 = single device).  A dimension of the
+    #: seconds-per-round signal, NOT a free covariate: sharded rounds
+    #: cost differently per shard (on a real mesh, less; on a 1-core
+    #: virtual mesh, more), so a fit must never silently pool 1-shard
+    #: and N-shard points — see :func:`fit_cost_model`'s ``shards``.
+    shards: int = 1
 
     @property
     def s_per_round(self) -> Optional[float]:
@@ -90,6 +96,10 @@ def _obs_from_probe_doc(doc: dict, source: str) -> List[ProbeObs]:
     out: List[ProbeObs] = []
     if not isinstance(doc, dict):
         return out
+    # the mesh dimension: modern records carry n_shards explicitly;
+    # historical scale_probe lines recorded their virtual mesh size as
+    # `devices` (0/absent = single device)
+    shards = int(doc.get("n_shards") or doc.get("devices") or 1)
     # r04 component-partitioned record: nested exec block, classes_total
     ex = doc.get("exec")
     if isinstance(ex, dict) and "wall_s" in ex:
@@ -102,6 +112,7 @@ def _obs_from_probe_doc(doc: dict, source: str) -> List[ProbeObs]:
                     source=source,
                     rounds=int(ex.get("iterations") or 0) or None,
                     wall_s=float(ex["wall_s"]),
+                    shards=shards,
                 )
             )
         return out
@@ -122,6 +133,7 @@ def _obs_from_probe_doc(doc: dict, source: str) -> List[ProbeObs]:
                 wall_s=float(doc["exec_wall_s"]),
                 # resumed records carry the chain's cumulative count
                 rounds_total=int(doc.get("iterations_total") or 0) or None,
+                shards=shards,
             )
         )
     elif doc.get("step_compile_s") is not None:
@@ -131,6 +143,7 @@ def _obs_from_probe_doc(doc: dict, source: str) -> List[ProbeObs]:
                 kind="compile",
                 source=source,
                 compile_s=float(doc["step_compile_s"]),
+                shards=shards,
             )
         )
     return out
@@ -175,9 +188,11 @@ def load_ledger_observations(path: str) -> List[ProbeObs]:
         opens = [r for r in recs if r.get("ev") == "open"]
         if not opens:
             continue
-        n = (opens[0].get("meta") or {}).get("n_classes")
+        meta = opens[0].get("meta") or {}
+        n = meta.get("n_classes")
         if not n:
             continue
+        shards = int(meta.get("n_shards") or meta.get("devices") or 1)
         rounds_ = [r for r in recs if r.get("ev") == "round"]
         if not rounds_:
             continue
@@ -208,6 +223,7 @@ def load_ledger_observations(path: str) -> List[ProbeObs]:
                 rounds_total=max(
                     int(r.get("round") or 0) for r in rounds_
                 ) or None,
+                shards=shards,
             )
         )
     return out
@@ -281,6 +297,14 @@ class CostModel:
     spr_coef: float
     spr_exp: float
     basis: List[dict] = field(default_factory=list)
+    #: the mesh shape this model was fitted FOR: the shard count whose
+    #: observations exclusively shaped the fit, or None when the basis
+    #: pooled mixed shard counts (either no ``shards`` was requested,
+    #: or nothing matched and the fit fell back — ``mixed_shards``
+    #: marks the fallback so a launch record shows the prediction is
+    #: cross-mesh extrapolation, not same-shape calibration)
+    shards: Optional[int] = None
+    mixed_shards: bool = False
 
     def predict_rounds(self, n: int) -> float:
         return max(1.0, self.rounds_coef * float(n) ** self.rounds_exp)
@@ -301,6 +325,8 @@ class CostModel:
             "predicted_wall_s": round(self.predict_wall_s(n), 1),
             "rounds_fit": [round(self.rounds_coef, 6), round(self.rounds_exp, 4)],
             "spr_fit": [round(self.spr_coef, 10), round(self.spr_exp, 4)],
+            "shards": self.shards,
+            "mixed_shards": self.mixed_shards,
             "basis": self.basis,
         }
 
@@ -310,14 +336,28 @@ class CostModel:
             "rounds_exp": self.rounds_exp,
             "spr_coef": self.spr_coef,
             "spr_exp": self.spr_exp,
+            "shards": self.shards,
+            "mixed_shards": self.mixed_shards,
             "basis": self.basis,
         }
 
 
-def fit_cost_model(observations: Sequence[ProbeObs]) -> Optional[CostModel]:
+def fit_cost_model(
+    observations: Sequence[ProbeObs], shards: Optional[int] = None
+) -> Optional[CostModel]:
     """Fit from executed observations; None when the basis holds no
     executed run at all (a guard without a model must say so, not
-    invent numbers)."""
+    invent numbers).
+
+    ``shards`` selects the mesh dimension: seconds-per-round is a
+    per-mesh-shape quantity (an N-shard round and a 1-shard round of
+    the same corpus are different programs on different silicon), so a
+    launch prediction fits ONLY from observations of the launching
+    run's shard count when any exist.  With none matching, the fit
+    falls back to the full pool — explicitly marked ``mixed_shards``
+    in the model and the launch record, never silently — because a
+    cross-mesh extrapolated guard still beats no guard (the SCALE_r05
+    failure mode was a hand-waved band, not a mis-dimensioned fit)."""
     ex = [
         o
         for o in observations
@@ -325,6 +365,13 @@ def fit_cost_model(observations: Sequence[ProbeObs]) -> Optional[CostModel]:
     ]
     if not ex:
         return None
+    mixed = False
+    if shards is not None:
+        matching = [o for o in ex if o.shards == int(shards)]
+        if matching:
+            ex = matching
+        else:
+            mixed = True
     # rounds fit: whole-run totals (a resumed tail's count would
     # under-predict); spr fit: the consistently paired tail rounds/wall
     rounds_coef, rounds_exp = _fit_power(
@@ -339,14 +386,21 @@ def fit_cost_model(observations: Sequence[ProbeObs]) -> Optional[CostModel]:
             "n_classes": o.n,
             "rounds": o.run_rounds,
             "s_per_round": round(o.s_per_round, 2),
+            "shards": o.shards,
         }
         for o in ex
     ]
-    return CostModel(rounds_coef, rounds_exp, spr_coef, spr_exp, basis)
+    return CostModel(
+        rounds_coef, rounds_exp, spr_coef, spr_exp, basis,
+        shards=(None if mixed or shards is None else int(shards)),
+        mixed_shards=mixed,
+    )
 
 
-def fit_from_paths(paths: Sequence[str]) -> Optional[CostModel]:
-    return fit_cost_model(gather_observations(paths))
+def fit_from_paths(
+    paths: Sequence[str], shards: Optional[int] = None
+) -> Optional[CostModel]:
+    return fit_cost_model(gather_observations(paths), shards=shards)
 
 
 def guard_launch(

@@ -5,3 +5,8 @@ from distel_tpu.parallel.mesh import (  # noqa: F401
     init_distributed,
     setup,
 )
+from distel_tpu.parallel.shard_compat import (  # noqa: F401
+    HAS_SHARD_MAP,
+    SHARD_MAP_SOURCE,
+    shard_map,
+)

@@ -1,0 +1,69 @@
+"""``shard_map`` compatibility across jax pins.
+
+The engines are written against the modern top-level ``jax.shard_map``
+API (``check_vma=`` keyword).  The current pin (0.4.37) predates that
+export but ships a fully working implementation at
+``jax.experimental.shard_map.shard_map`` whose only API delta is the
+keyword's name: the replication/varying-manual-axes check is spelled
+``check_rep`` there.  This module resolves whichever the pin provides
+and normalizes the keyword, so every mesh entry point
+(``rowpacked_engine._shard_jit``, ``packed_engine._sharded_run``, the
+sharded sparse-tier program) writes one call and runs on either
+vintage.
+
+Verified semantics on the 0.4.37 experimental implementation (the
+tier-1 sharded suite pins them): ``check_rep=False`` accepts
+replicated ``P()``/``P(None)`` out_specs for values made uniform by
+construction (psum'd votes, folded frontier masks), collectives inside
+``lax.cond`` branches with a replicated predicate, and pytree
+in_specs — everything the engines' shard_map structure uses.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):  # modern pins: the top-level export
+    _impl = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:  # 0.4.x pins: the experimental module, check_vma spelled check_rep
+    try:
+        from jax.experimental.shard_map import shard_map as _impl
+
+        _CHECK_KW = "check_rep"
+    except ImportError:  # pragma: no cover - no known pin hits this
+        _impl = None
+        _CHECK_KW = None
+
+#: True when this pin provides a usable shard_map under either name —
+#: the probe ``tests/sharding_support.py`` keys its skips on (NOT
+#: ``hasattr(jax, "shard_map")``, which reads False on 0.4.x pins whose
+#: experimental implementation is fully functional).
+HAS_SHARD_MAP = _impl is not None
+
+#: where the implementation came from, for diagnostics/docs:
+#: ``"jax"`` (top-level) or ``"jax.experimental.shard_map"``
+SHARD_MAP_SOURCE = (
+    "jax"
+    if hasattr(jax, "shard_map")
+    else ("jax.experimental.shard_map" if HAS_SHARD_MAP else None)
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` with the kwarg normalized for the resolved
+    implementation.  ``check_vma`` follows the modern spelling; on an
+    experimental-pin resolution it is passed through as ``check_rep``
+    (same meaning: verify outputs declared replicated really are)."""
+    if _impl is None:  # pragma: no cover - no known pin hits this
+        raise RuntimeError(
+            "this jax pin provides neither jax.shard_map nor "
+            "jax.experimental.shard_map.shard_map"
+        )
+    return _impl(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

@@ -114,7 +114,8 @@ def make_engine(
         rowpacked_kw.setdefault("bucket_ratio", config.bucket_ratio)
         # adaptive sparse-tail controller for observed runs: low-density
         # rounds run the frontier-compacted step instead of the dense
-        # sweep (single-device; the engine ignores it otherwise)
+        # sweep — single-device AND mesh engines (the sparse program
+        # builds in the same shard_map structure as the dense step)
         rowpacked_kw.setdefault(
             "sparse_tail", config.sparse_tail_config()
         )

@@ -39,9 +39,9 @@ def cpu_mesh_env(n_devices: int, base: dict = None) -> dict:
     return env
 
 
-def cpu_mesh_ready(n_devices: int) -> bool:
-    """True iff JAX in THIS process is already initialized on a pure-CPU
-    backend with at least ``n_devices`` devices (the pytest/conftest case).
+def initialized_devices() -> list:
+    """The device list of an ALREADY-INITIALIZED backend, else [] —
+    the one guarded owner of the private-API probe.
 
     Deliberately does NOT call ``jax.devices()`` when backends are still
     uninitialized: in the driver environment a sitecustomize hook
@@ -52,19 +52,27 @@ def cpu_mesh_ready(n_devices: int) -> bool:
     import sys
 
     if "jax" not in sys.modules:
-        return False
+        return []
     jax = sys.modules["jax"]
     try:
         import jax._src.xla_bridge as xb
 
         if not xb.backends_are_initialized():
-            return False
+            return []
     except (ImportError, AttributeError):
-        return False  # private-API drift: report not-ready (safe path)
+        return []  # private-API drift: report not-ready (safe path)
     try:
-        devices = jax.devices()
+        return list(jax.devices())
     except Exception:
-        return False
+        return []
+
+
+def cpu_mesh_ready(n_devices: int) -> bool:
+    """True iff JAX in THIS process is already initialized on a pure-CPU
+    backend with at least ``n_devices`` devices (the pytest/conftest
+    case).  See :func:`initialized_devices` for why an uninitialized
+    backend reads not-ready instead of being probed."""
+    devices = initialized_devices()
     return len(devices) >= n_devices and all(
         d.platform == "cpu" for d in devices
     )

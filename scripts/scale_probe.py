@@ -98,12 +98,14 @@ def _parse_args(argv=None):
     ap.add_argument("--no-sparse-tail", dest="sparse_tail",
                     action="store_false", default=True,
                     help="disable the adaptive sparse-tail controller "
-                         "on observed --execute runs (single-device "
-                         "only; mesh runs are dense regardless — the "
-                         "sharded sparse tier is a ROADMAP open item). "
-                         "When active, per-round progress lines carry "
-                         "tier/density/rows_touched and the record "
-                         "gains a sparse_tail summary")
+                         "on observed --execute runs.  Mesh runs are "
+                         "covered too: the sparse program builds in "
+                         "the same shard_map structure as the dense "
+                         "step, so sharded tail rounds cost what they "
+                         "derive (the ISSUE 15 port).  When active, "
+                         "per-round progress lines carry tier/density/"
+                         "rows_touched and the record gains a "
+                         "sparse_tail summary")
     ap.add_argument("--pipeline-depth", type=int, default=None,
                     help="speculative in-flight rounds for observed "
                          "--execute runs (default: the engine's "
@@ -225,7 +227,12 @@ def run_probe(args) -> None:
             basis = costmodel.default_basis_paths(_REPO)
             if ledger_path and os.path.exists(ledger_path):
                 basis.append(ledger_path)
-        model = costmodel.fit_from_paths(basis)
+        # the fit is dimensioned on THIS launch's mesh shape: 1-shard
+        # and N-shard seconds-per-round points never silently pool
+        # (a cross-mesh fallback is marked mixed_shards in the record)
+        model = costmodel.fit_from_paths(
+            basis, shards=args.devices or 1
+        )
         if args.stage_budget_s is not None:
             guard = costmodel.guard_launch(
                 model, args.n_classes, args.stage_budget_s,
@@ -321,10 +328,11 @@ def run_probe(args) -> None:
     # the sparse tier rides the scanned CR4/CR6 formulation (pinned
     # bit-identical to the unrolled one by tests/test_scan_engine.py);
     # at SNOMED scale scan mode auto-engages anyway, so forcing it here
-    # only affects small probes that asked for the sparse tail
-    want_sparse = bool(
-        args.sparse_tail and args.devices == 0 and will_observe
-    )
+    # only affects small probes that asked for the sparse tail.  Mesh
+    # runs qualify since ISSUE 15: the sparse program builds inside the
+    # same shard_map structure as the dense step, and the pipelined
+    # controller drives both paths identically
+    want_sparse = bool(args.sparse_tail and will_observe)
     engine = RowPackedSaturationEngine(
         idx, mesh=mesh,
         sparse_tail=(True if want_sparse else None),
@@ -335,6 +343,9 @@ def run_probe(args) -> None:
         ),
     )
     rec["build_s"] = round(time.time() - t0, 1)
+    # the resolved mesh shape (1 = single device): the ledger meta and
+    # the cost model's shards dimension both key on it
+    rec["n_shards"] = int(engine.n_shards)
     rec["sparse_tail_enabled"] = bool(
         want_sparse and engine._sparse_supported()
     )
@@ -458,8 +469,9 @@ def run_probe(args) -> None:
                 meta={
                     k: rec[k]
                     for k in (
-                        "n_classes", "shape", "devices", "backend",
-                        "n_concepts", "n_links", "bucket_signature",
+                        "n_classes", "shape", "devices", "n_shards",
+                        "backend", "n_concepts", "n_links",
+                        "bucket_signature",
                     )
                     if k in rec
                 },

@@ -29,6 +29,12 @@ def pytest_configure(config):
         "no_lockdep: opt out of the runtime lockdep shim (for tests "
         "that intentionally seed inversions or contend on raw locks)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: opt-in stress/soak harnesses excluded from tier-1 "
+        "(`-m 'not slow'`); run explicitly, e.g. "
+        "`pytest -m slow tests/test_restore_churn_stress.py`",
+    )
 
 
 @pytest.fixture(autouse=True)

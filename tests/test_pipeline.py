@@ -22,7 +22,10 @@ from distel_tpu.core.engine import SaturationEngine
 from distel_tpu.core.indexing import index_ontology
 from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
 from distel_tpu.frontend.normalizer import normalize
-from distel_tpu.frontend.ontology_tools import synthetic_ontology
+from distel_tpu.frontend.ontology_tools import (
+    chain_tailed_ontology,
+    synthetic_ontology,
+)
 from distel_tpu.owl import parser
 from distel_tpu.runtime.instrumentation import FRONTIER_EVENTS
 
@@ -37,16 +40,7 @@ def galen_idx():
     subclass-chain tail — late rounds derive one chain hop each, so
     the run has a long tail of cheap rounds for the pipeline (and the
     sparse tier) to work on."""
-    n = 400
-    text = synthetic_ontology(
-        n_classes=n, n_anatomy=n // 10, n_locations=n // 12,
-        n_definitions=n // 20,
-    )
-    text += "\n" + "\n".join(
-        f"SubClassOf(TailChain{i} TailChain{i + 1})" for i in range(12)
-    )
-    text += "\nSubClassOf(Class0 TailChain0)"
-    return _indexed(text)
+    return _indexed(chain_tailed_ontology(400, 12))
 
 
 def _observed(idx, sparse, pipeline, **kw):

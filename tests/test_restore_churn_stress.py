@@ -1,0 +1,155 @@
+"""Opt-in stress harness for the warm-process restore/resume closure
+flake (ROADMAP: "Root-cause the warm-process restore/resume closure
+flake").
+
+Full tier-1 runs on 1-2-core hosts intermittently fail 2-5
+restore/resume-path tests with UNDER-SATURATED closures — told axioms
+missing from the taxonomy, i.e. a device program returned wrong bits —
+while every failing test passes in isolation.  The suspects are all
+warm-process state: PROGRAMS LRU eviction timing (capacity 32 against
+hundreds of programs in a full suite), the shared persistent compile
+cache, and host memory pressure.  This harness reproduces exactly that
+regime in one opt-in test: a long loop of fresh-classify +
+restore/resume cycles against a PROGRAMS registry kept churning by a
+rotating corpus roster under a pinched capacity, asserting the closure
+against the CPU oracle EVERY round — the bisectable repro the
+root-cause item needs (run it at a suspect commit; first wrong round
+prints its full context).
+
+Run:  ``pytest -m slow tests/test_restore_churn_stress.py -q``
+Tune: ``DISTEL_STRESS_ROUNDS`` (default 24),
+      ``DISTEL_STRESS_CACHE_CAPACITY`` (default 2 — the pinch; the
+      env knob ``DISTEL_PROGRAM_CACHE_CAPACITY`` reads at import, so
+      the harness pinches the live registry's ``capacity`` directly:
+      same eviction code path, toggleable per test)
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.program_cache import PROGRAMS
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import chain_tailed_ontology
+from distel_tpu.owl import parser
+from distel_tpu.testing.differential import diff_engine_vs_oracle
+
+
+def _corpora():
+    """A roster spanning DISTINCT bucket rungs (sizes chosen off the
+    x1.25 ladder's collision ranges), so each round's engine wants a
+    different program set and a pinched registry must evict."""
+    out = []
+    for n in (60, 110, 180, 260):
+        text = chain_tailed_ontology(
+            n, 6, n_anatomy=max(n // 8, 2),
+            n_locations=max(n // 10, 2), n_definitions=max(n // 16, 2),
+        )
+        norm = normalize(parser.parse(text))
+        out.append((n, text, norm, index_ontology(norm)))
+    return out
+
+
+@pytest.mark.slow
+def test_restore_resume_closure_under_registry_churn():
+    rounds = int(os.environ.get("DISTEL_STRESS_ROUNDS", "24"))
+    pinch = int(os.environ.get("DISTEL_STRESS_CACHE_CAPACITY", "2"))
+    roster = _corpora()
+    cap0 = PROGRAMS.capacity
+    ev0 = PROGRAMS.evictions
+    closures = {}  # n -> (packed_s, packed_r) of round 1, pinned
+    PROGRAMS.capacity = max(pinch, 1)
+    try:
+        for r in range(rounds):
+            n, _text, norm, idx = roster[r % len(roster)]
+            ctx = f"round {r} corpus {n} (evictions {PROGRAMS.evictions})"
+            # fresh classify on a FRESH engine: its programs must come
+            # through the churning registry (bucket mode), not an
+            # engine-local cache
+            engine = RowPackedSaturationEngine(idx, bucket=True)
+            full = engine.saturate()
+            report = diff_engine_vs_oracle(norm, full)
+            assert report.ok(), f"{ctx}: fresh closure wrong: " \
+                f"{report.summary()}"
+            ps = np.asarray(full.packed_s)
+            pr = np.asarray(full.packed_r)
+            # cross-round byte-stability: the same corpus classified by
+            # a warm process must reproduce round 1's closure exactly
+            if n in closures:
+                assert np.array_equal(ps, closures[n][0]) and \
+                    np.array_equal(pr, closures[n][1]), \
+                    f"{ctx}: warm-process closure drifted from round 1"
+            else:
+                closures[n] = (ps, pr)
+            # restore/resume on ANOTHER fresh engine (the serve
+            # eviction-reload / resume-from-snapshot shape): embedding
+            # the wire state and resaturating must converge immediately
+            # with zero new derivations
+            resumed = RowPackedSaturationEngine(idx, bucket=True).saturate(
+                initial=(ps, pr)
+            )
+            assert resumed.derivations == 0, \
+                f"{ctx}: resume rederived {resumed.derivations} bits " \
+                "(restored closure was under-saturated)"
+            assert np.array_equal(np.asarray(resumed.packed_s), ps), \
+                f"{ctx}: resume mutated the closure"
+    finally:
+        PROGRAMS.capacity = cap0
+    # the harness only means anything if the pinch actually churned
+    assert PROGRAMS.evictions > ev0, (
+        "registry never evicted — raise DISTEL_STRESS_ROUNDS or lower "
+        "DISTEL_STRESS_CACHE_CAPACITY"
+    )
+
+
+@pytest.mark.slow
+def test_registry_spill_restore_closure_under_churn(tmp_path):
+    """The serve-registry variant of the loop above — the layer the
+    observed tier-1 failures actually live in (spill/reload, taxonomy
+    extraction after restore).  Each round: load a rotating corpus
+    into an OntologyRegistry, pin its taxonomy, force a spill, reload
+    through the classifier accessor, and assert the re-extracted
+    taxonomy is byte-identical — under the same pinched-PROGRAMS
+    churn.  A wrong parent here is the exact failure shape the flake
+    shows (e.g. B:[C] becoming B:[E] when a mid-chain subsumption
+    drops out of a restored closure)."""
+    import json
+
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.taxonomy import extract_taxonomy
+    from distel_tpu.serve.registry import OntologyRegistry
+
+    rounds = int(os.environ.get("DISTEL_STRESS_ROUNDS", "24"))
+    pinch = int(os.environ.get("DISTEL_STRESS_CACHE_CAPACITY", "2"))
+    roster = _corpora()
+    cap0 = PROGRAMS.capacity
+    PROGRAMS.capacity = max(pinch, 1)
+    reg = OntologyRegistry(
+        ClassifierConfig(), spill_dir=str(tmp_path),
+        fast_path_min_concepts=0,
+    )
+    try:
+        for r in range(rounds):
+            n, text, _norm, _idx = roster[r % len(roster)]
+            ctx = f"round {r} corpus {n} (evictions {PROGRAMS.evictions})"
+            oid = reg.new_id()
+            reg.load(oid, text)
+            entry = reg._entries[oid]
+            before = json.dumps(
+                extract_taxonomy(reg.classifier(oid).last_result).parents,
+                sort_keys=True,
+            )
+            with entry.lock:
+                reg._spill(entry)
+            after = json.dumps(
+                extract_taxonomy(reg.classifier(oid).last_result).parents,
+                sort_keys=True,
+            )
+            assert after == before, (
+                f"{ctx}: taxonomy changed across spill/restore"
+            )
+    finally:
+        PROGRAMS.capacity = cap0

@@ -1,0 +1,148 @@
+"""Sharded adaptive controller parity (ISSUE 15): the sparse-tail +
+pipelined controller under shard_map.
+
+The soundness claim under test extends tests/test_sparse_tail.py's to
+the mesh path: a SHARDED adaptive run — dense rounds through the
+shard_map-structured observe program, sparse rounds through the
+shard_map-structured compacted program, speculative dispatch at any
+pipeline depth — retires a per-round (iteration, derivations, changed)
+sequence BYTE-IDENTICAL to the single-device adaptive controller's,
+and lands byte-identical final closures.  That holds because the
+sparse program's body is the single shared ``_sparse_exec`` (the mesh
+build only narrows state to the shard-local word window and psum-folds
+the round's frontier ONCE at the end), and the controller's host logic
+never branches on the mesh.
+
+Also pinned: the compat shim resolves on this pin (these tests would
+read as the old skips otherwise) and the sharded sparse program
+actually runs the sparse tier (not a silent dense fallback).
+"""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.engine import fetch_global
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import chain_tailed_ontology
+from distel_tpu.owl import parser
+
+from sharding_support import requires_shard_map
+
+
+@pytest.fixture(scope="module")
+def galen_idx():
+    """Chain-tailed GALEN shape (the sparse tier's regime), sized so a
+    2-shard word axis still holds multiple words per shard.  The
+    DisjointClasses axiom makes the chain's midsection unsatisfiable
+    (TailChain3 ⊑ … ⊑ TailChain7 ⊓ ¬TailChain7), so the engines build
+    with ⊥ present and the sharded sparse program's CR5 branch — the
+    masked-local-extract + psum exchange inside a ``lax.cond`` — is
+    actually traced and exercised by every parity assertion below
+    (without it no corpus in the suite reaches that code)."""
+    text = chain_tailed_ontology(400, 12)
+    text += "\nDisjointClasses(TailChain3 TailChain7)"
+    return index_ontology(normalize(parser.parse(text)))
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices (see conftest.py)")
+    return jax.sharding.Mesh(np.array(jax.devices()[:2]), ("c",))
+
+
+def _observed(idx, mesh, sparse, depth):
+    engine = RowPackedSaturationEngine(
+        idx, unroll=1, bucket=True, mesh=mesh
+    )
+    rounds = []
+    res = engine.saturate_observed(
+        observer=lambda it, d, ch: rounds.append((it, d, ch)),
+        sparse_tail=sparse,
+        pipeline={"enable": depth > 1, "depth": depth},
+    )
+    return engine, rounds, res
+
+
+def _closure(res):
+    return tuple(
+        np.asarray(a)
+        for a in fetch_global((res.packed_s, res.packed_r))
+    )
+
+
+#: forces every post-warmup round sparse — the strictest exercise of
+#: the sharded selection/compaction path (same knob the single-device
+#: parity fixture uses)
+_ALL_SPARSE = {"density_threshold": 1.1, "hysteresis_rounds": 1}
+
+
+@requires_shard_map
+def test_sharded_adaptive_dense_only_matches_local(galen_idx, mesh2):
+    """Dense-only (sparse tail off) sharded adaptive vs single-device:
+    identical retired round sequence and closures at the default
+    pipeline depth."""
+    _, lr, res_l = _observed(galen_idx, None, {"enable": False}, 2)
+    _, sr, res_s = _observed(galen_idx, mesh2, {"enable": False}, 2)
+    assert sr == lr
+    cl, cs = _closure(res_l), _closure(res_s)
+    assert np.array_equal(cl[0], cs[0]) and np.array_equal(cl[1], cs[1])
+    # the fixture's disjointness really fired: ⊥ propagation (CR5) is
+    # live in every run this module compares
+    assert res_s.unsatisfiable()
+
+
+@requires_shard_map
+def test_sharded_sparse_interleave_matches_local(galen_idx, mesh2):
+    """Sparse-tail interleave: the sharded controller must RUN the
+    sparse tier (not silently fall back dense) and still retire the
+    single-device adaptive sequence byte-for-byte."""
+    el, lr, res_l = _observed(galen_idx, None, _ALL_SPARSE, 1)
+    es, sr, res_s = _observed(galen_idx, mesh2, _ALL_SPARSE, 1)
+    assert sr == lr
+    cl, cs = _closure(res_l), _closure(res_s)
+    assert np.array_equal(cl[0], cs[0]) and np.array_equal(cl[1], cs[1])
+    tiers_l = [s.tier for s in el.frontier_rounds]
+    tiers_s = [s.tier for s in es.frontier_rounds]
+    # depth 1 drains between every round: tier decisions see the same
+    # frontier on both paths and must agree round for round
+    assert tiers_s == tiers_l
+    assert tiers_s.count("sparse") >= 3
+
+
+@requires_shard_map
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_sharded_pipeline_depths_match_local(galen_idx, mesh2, depth):
+    """Pipeline depths 1/2/4: speculative dispatch on the mesh path
+    must retire the same rounds as the single-device controller AT THE
+    SAME DEPTH (the drain-before-tier-switch slack may shift WHICH
+    rounds run sparse across depths — never what any round derives)."""
+    _, lr, res_l = _observed(galen_idx, None, _ALL_SPARSE, depth)
+    _, sr, res_s = _observed(galen_idx, mesh2, _ALL_SPARSE, depth)
+    assert sr == lr
+    cl, cs = _closure(res_l), _closure(res_s)
+    assert np.array_equal(cl[0], cs[0]) and np.array_equal(cl[1], cs[1])
+    # the dense-only reference: every depth's retired sequence is the
+    # synchronous dense loop's (the adaptive + pipelined machinery is
+    # observability-neutral end to end)
+    _, dr, _res_d = _observed(galen_idx, None, {"enable": False}, 1)
+    assert sr == dr
+
+
+@requires_shard_map
+def test_sharded_sparse_program_is_sharded(galen_idx, mesh2):
+    """The sparse program's state outputs stay word-axis sharded (the
+    round must not silently gather to one device and re-scatter)."""
+    engine = RowPackedSaturationEngine(
+        idx := galen_idx, unroll=1, bucket=True, mesh=mesh2
+    )
+    res = engine.saturate_observed(sparse_tail=_ALL_SPARSE)
+    assert any(s.tier == "sparse" for s in engine.frontier_rounds)
+    assert len(res.packed_s.sharding.device_set) == 2
+    shard_cols = {sh.data.shape[1] for sh in res.packed_s.addressable_shards}
+    assert shard_cols == {engine.wc // 2}
+    assert idx.n_concepts  # fixture sanity
