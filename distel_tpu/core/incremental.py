@@ -431,9 +431,17 @@ class IncrementalClassifier:
         #: retained by :meth:`demote` so :meth:`promote` can rebuild
         #: without replaying the frontend
         self._warm_idx = None
+        #: span provenance, one record per ingest (ISSUE 16): ``_merge``
+        #: appends each batch's rows onto the accumulated lists in
+        #: order, so every ingest owns a CONTIGUOUS ``(start, end)``
+        #: range per NF family — enough provenance for :meth:`retract`
+        #: to map a text back to the told rows it produced.  Records:
+        #: ``{"text", "spans": {nf: (start, end)} | None, "retracted"}``
+        #: (spans shift down as earlier ingests are retracted).
+        self._ingests: List[dict] = []
 
     def add_text(self, text: str) -> SaturationResult:
-        return self.add_ontology(owl_loader.load(text))
+        return self.add_ontology(owl_loader.load(text), source_text=text)
 
     def drop_base_program(self) -> None:
         """Forget the compiled base program so the NEXT delta takes the
@@ -509,13 +517,20 @@ class IncrementalClassifier:
         self.last_result = result
         return result
 
-    def _ingest(self, onto):
+    def _ingest(self, onto, source_text: Optional[str] = None):
         """Frontend half of an increment: normalize the batch under the
         persistent caches (gensym memo, range state), merge it into the
         accumulated corpus, and re-index with the append-only
         ``Indexer``.  No saturation — split out so ``restore`` can
         replay a spilled classifier's numbering without re-deriving its
-        closure.  Returns ``(idx, batch)``."""
+        closure.  Returns ``(idx, batch)``.
+
+        ``source_text``: the raw axiom text this batch came from —
+        recorded with the batch's row spans so :meth:`retract` can map
+        the text back to its rows (``None`` leaves the ingest
+        unretractable, e.g. pre-parsed ontology objects)."""
+        from distel_tpu.core.retract import NF_FAMILIES
+
         normalizer = Normalizer(
             cache=self._normalizer_cache, range_state=self._range_state
         )
@@ -523,19 +538,37 @@ class IncrementalClassifier:
         # append-only range retrofit of earlier increments' rows (the
         # emitted rows land in ``batch`` and merge like any delta; a
         # retrofit that creates links rides the link-delta fast path or
-        # overflows into the rebuild path like any other link growth)
+        # overflows into the rebuild path like any other link growth).
+        # NOTE the retrofit rows are attributed to THIS ingest's spans
+        # even though they belong to older texts — the reason
+        # :meth:`retract` refuses while range machinery is active.
         normalizer.retrofit_ranges(self.accumulated.nf3, self._range_eff)
         self._normalizer_cache = normalizer.export_cache()
         self._range_state = normalizer.export_range_state()
+        before = {
+            fam: len(getattr(self.accumulated, fam)) for fam in NF_FAMILIES
+        }
         _merge(self.accumulated, batch)
+        self._ingests.append(
+            {
+                "text": source_text,
+                "spans": {
+                    fam: (before[fam], len(getattr(self.accumulated, fam)))
+                    for fam in NF_FAMILIES
+                },
+                "retracted": False,
+            }
+        )
         self._range_eff = {
             r: normalizer.effective_ranges(r)
             for r in self.accumulated.roles()
         }
         return self.indexer.index(self.accumulated), batch
 
-    def add_ontology(self, onto) -> SaturationResult:
-        idx, batch = self._ingest(onto)
+    def add_ontology(
+        self, onto, source_text: Optional[str] = None
+    ) -> SaturationResult:
+        idx, batch = self._ingest(onto, source_text=source_text)
         self.last_compile = None
         self.last_delta_stats = None
         result = self._delta_fast_path(idx)
@@ -584,6 +617,99 @@ class IncrementalClassifier:
         self.last_result = result
         return result
 
+    # --------------------------------------------------------- retraction
+
+    def retract(self, text: str) -> SaturationResult:
+        """Retract a previously-added axiom text and repair the closure
+        (DRed delete-and-rederive, ``core/retract.py`` — ISSUE 16).
+
+        The text must match a live prior :meth:`add_text` /
+        :meth:`add_ontology` ``source_text`` exactly; the ingest's row
+        spans locate the told rows to remove.  Refusals
+        (:class:`~distel_tpu.core.retract.RetractionError` subclasses)
+        mutate nothing.  The repair clears the overdeletion set's S/R
+        rows and re-saturates from the surviving told axioms via the
+        normal rebuild machinery — same concept/link universe (ids are
+        append-only), so under shape buckets the repair's engine is a
+        program-registry hit and a small repair compiles nothing.  The
+        repaired result is byte-identical (taxonomy level) to a
+        from-scratch classify of the surviving texts.
+
+        Note the overdeletion reads the unpacked closure on the host —
+        O(closure) like a snapshot spill; retraction is a rare op, not
+        steady-state traffic."""
+        from distel_tpu.core import retract as retract_mod
+
+        if self.last_result is None:
+            raise retract_mod.RetractionError(
+                "retract needs a saturated closure "
+                "(no increment has completed)"
+            )
+        k = retract_mod.find_ingest(self._ingests, text)
+        if (self._range_state and self._range_state[0]) or any(
+            self._range_eff.values()
+        ):
+            raise retract_mod.EntangledRetraction(
+                "retraction refused: range-elimination machinery is "
+                "active — range retrofits re-emit rows for OLD axioms "
+                "into later batches, so span provenance cannot "
+                "attribute rows to texts"
+            )
+        spans = self._ingests[k]["spans"]
+        dead = retract_mod.dead_rows(self.accumulated, spans)
+        retract_mod.check_entanglement(self.accumulated, spans, dead)
+        # ---- all refusal checks passed: mutate
+        res = self.last_result
+        aff = retract_mod.affected_concepts(res.idx, res.s, res.r, dead)
+        retract_mod.remove_spans(self.accumulated, self._ingests, k)
+        retract_mod.purge_normalizer_cache(self._normalizer_cache, dead)
+        # re-index the surviving corpus: ids are append-only and the
+        # survivors are a subset, so the concept/link universe (and the
+        # bucket signature) is unchanged — only the told tables,
+        # role closure, and original_classes shrink
+        idx = self.indexer.index(self.accumulated)
+        self._state = retract_mod.clear_rows(res.s, res.r, aff)
+        self.last_compile = None
+        self.last_delta_stats = None
+        result = self._full_rebuild(idx)
+        if result.transposed:
+            self._state = (result.packed_s, result.packed_r)
+        else:
+            self._state = (result.s, result.r)
+        self.increment += 1
+        rows_removed = sum(len(v) for v in dead.values())
+        self.history.append(
+            {
+                "increment": self.increment,
+                "retracted_rows": rows_removed,
+                "affected_concepts": int(aff.sum()),
+                "iterations": result.iterations,
+                "new_derivations": result.derivations,
+                "path": "retract",
+                **(
+                    self.last_compile.as_dict()
+                    if self.last_compile is not None
+                    else {}
+                ),
+            }
+        )
+        self.last_result = result
+        return result
+
+    def _replay_retract(self, text: str) -> None:
+        """Frontend-only retraction replay for :meth:`restore`: remove
+        the rows and purge the memo exactly like :meth:`retract`, but
+        derive nothing — the closure comes from the post-repair
+        snapshot being restored."""
+        from distel_tpu.core import retract as retract_mod
+
+        k = retract_mod.find_ingest(self._ingests, text)
+        dead = retract_mod.dead_rows(
+            self.accumulated, self._ingests[k]["spans"]
+        )
+        retract_mod.remove_spans(self.accumulated, self._ingests, k)
+        retract_mod.purge_normalizer_cache(self._normalizer_cache, dead)
+
     # --------------------------------------------------- spill / restore
 
     def snapshot(self, path: str, compressed: bool = True) -> None:
@@ -612,7 +738,11 @@ class IncrementalClassifier:
         only (parse → normalize → index — no saturation) reconstructs
         the persistent caches and the exact append-only numbering the
         snapshot was taken under, so the spilled state re-embeds as an
-        identity remap.  One full rebuild then warm-starts from the
+        identity remap.  Entries may also be retraction markers
+        (``{"op": "retract", "text": ...}`` — the serve registry's
+        op-log form): those replay through the frontend too (row
+        removal + memo purge, :meth:`_replay_retract`), no repair —
+        the snapshot already holds the post-repair closure.  One full rebuild then warm-starts from the
         embedded closure; monotone EL+ saturation makes it a converged
         start, so the fixed point terminates after one quiet pass and
         the restored classifier is ready for further deltas (with a
@@ -626,8 +756,18 @@ class IncrementalClassifier:
 
         inc = cls(config)
         idx = None
-        for text in texts:
-            idx, _ = inc._ingest(owl_loader.load(text))
+        for entry in texts:
+            if isinstance(entry, dict):
+                if entry.get("op") != "retract":
+                    raise ValueError(
+                        f"unknown op-log entry in restore: {entry!r}"
+                    )
+                inc._replay_retract(entry["text"])
+                idx = inc.indexer.index(inc.accumulated)
+            else:
+                idx, _ = inc._ingest(
+                    owl_loader.load(entry), source_text=entry
+                )
             inc.increment += 1
         if idx is None:
             raise ValueError("restore needs at least one replayed text")

@@ -271,10 +271,21 @@ class Indexer:
         chain_pairs.sort()
 
         n_concepts = len(self.concept_names)
+        # original classes = concepts the CURRENT corpus still mentions.
+        # The name roster is append-only (stable-id contract), so after a
+        # retraction a dead concept keeps its id and its row in
+        # ``concept_names`` — membership in the live atom set is what
+        # decides whether the taxonomy should speak for it.  Add-only
+        # histories are unaffected: every interned non-aux name came from
+        # some batch's atoms, and the accumulated corpus only grows.
+        live = {atom_key(a) for a in norm.atoms()}
+        live.add("owl:Nothing")
+        live.add("owl:Thing")
         original = [
             i
             for i, name in enumerate(self.concept_names)
-            if not name.startswith(("distel:gensym#", AUX_PREFIX, "ind:"))
+            if name in live
+            and not name.startswith(("distel:gensym#", AUX_PREFIX, "ind:"))
         ]
 
         has_bottom = any(b == BOTTOM_ID for _, b in nf1_rows) or any(

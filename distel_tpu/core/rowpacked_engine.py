@@ -58,6 +58,7 @@ barrier + AND-vote (``controller/CommunicationHandler.java:49-84``).
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 from typing import Optional, Tuple
@@ -102,6 +103,30 @@ from distel_tpu.runtime.instrumentation import (
     FrontierStats,
     compile_watch,
 )
+
+
+def _state_donation() -> tuple:
+    """Donation indices for the saturation programs' two state buffers
+    (``donate_argnums``-style), or ``()`` on the CPU backend.
+
+    Donating the state halves peak state memory where it matters — HBM:
+    every run embeds into fresh arrays, so the input copy XLA would
+    otherwise retain across the fixed point is pure waste.  On PJRT-CPU
+    the saving is host RAM (irrelevant at these scales) and donation is
+    actively unsafe in warm serving processes: the in-place aliasing of
+    donated while-loop state intermittently recycles the aliased pages
+    while host-side reads of the produced closure are still pending,
+    observed as garbage or empty closures (told subsumptions missing
+    from freshly repaired taxonomies) and glibc heap corruption
+    ("corrupted double-linked list" replica aborts) in fleet replicas —
+    reproduced at ~1/3 rate under ``MALLOC_PERTURB_``, zero with
+    donation off.  The warm-process restore/resume closure flake
+    (ROADMAP) has the same signature.  ``DISTEL_DONATE_RUN_STATE=0/1``
+    forces either posture (bisection knob)."""
+    forced = os.environ.get("DISTEL_DONATE_RUN_STATE")
+    if forced is not None:
+        return (0, 1) if forced == "1" else ()
+    return () if jax.default_backend() == "cpu" else (0, 1)
 
 
 #: budget-floor chunk count past which the CR4/CR6 contractions compile
@@ -1644,12 +1669,13 @@ class RowPackedSaturationEngine:
         self._observe_jit = None
         self._live_bits_jit = None
         self._embed_dev_jit = None
-        # donate the state buffers: every saturate() builds fresh arrays
-        # (initial_state / embed_state), and without donation XLA keeps a
-        # full input copy alive across the loop — 2x state memory
+        # donate the state buffers where safe (see _state_donation): every
+        # saturate() builds fresh arrays (initial_state / embed_state), and
+        # without donation XLA keeps a full input copy alive across the loop
+        # — 2x state memory
         if mesh is None:
             self._run_jit = jax.jit(
-                self._run, static_argnums=(3,), donate_argnums=(0, 1)
+                self._run, static_argnums=(3,), donate_argnums=_state_donation()
             )
         else:
             self._run_jit = functools.lru_cache(maxsize=4)(self._sharded_run)
@@ -2613,7 +2639,7 @@ class RowPackedSaturationEngine:
         rp_av = jax.ShapeDtypeStruct((self.nl, self.wc), jnp.uint32)
         sa_av = self._sparse_avals(c123, a4, a6)
         if self.mesh is None:
-            fn = jax.jit(self._sparse_exec, donate_argnums=(0, 1))
+            fn = jax.jit(self._sparse_exec, donate_argnums=_state_donation())
         else:
             # the mesh variant runs the SAME body inside the same
             # shard_map structure as the dense step: state sharded on
@@ -2636,7 +2662,7 @@ class RowPackedSaturationEngine:
                     out_specs=(state, state, P(), P(), P(), P(), P()),
                     check_vma=False,
                 ),
-                donate_argnums=(0, 1),
+                donate_argnums=_state_donation(),
             )
 
         def build():
@@ -3952,7 +3978,7 @@ class RowPackedSaturationEngine:
                 P(axis),
                 P(axis),
             ),
-            donate=(0, 1),
+            donate=_state_donation(),
         )
 
     def _observe_round(self, sp, rp, dirty, masks, axis_name=None):
@@ -3971,9 +3997,10 @@ class RowPackedSaturationEngine:
     def _ensure_observe_jit(self):
         if self._observe_jit is None:
             # old sp/rp are dead after each round — donate the buffers
+            # (where safe, see _state_donation)
             if self.mesh is None:
                 self._observe_jit = jax.jit(
-                    self._observe_round, donate_argnums=(0, 1)
+                    self._observe_round, donate_argnums=_state_donation()
                 )
             else:
                 P = jax.sharding.PartitionSpec
@@ -3997,7 +4024,7 @@ class RowPackedSaturationEngine:
                         P(axis),
                         P(None),
                     ),
-                    donate=(0, 1),
+                    donate=_state_donation(),
                     with_dirty=True,
                 )
 

@@ -228,6 +228,22 @@ class ServeClient:
         self._note_version(oid, rec)
         return rec
 
+    def retract(
+        self, oid: str, text: str, deadline_s: Optional[float] = None
+    ) -> dict:
+        """Retract a previously-applied text (DRed delete-and-rederive;
+        the text must byte-match a prior load/delta text).  404: never
+        ingested / already retracted; 409: refused as entangled (shared
+        normalization gensyms or active range machinery).  The response
+        version is the repaired snapshot's — read-your-writes covers
+        the retraction like any delta."""
+        rec = self._request(
+            "POST", f"/v1/ontologies/{oid}/retract", {"text": text},
+            deadline_s,
+        )
+        self._note_version(oid, rec)
+        return rec
+
     def subsumers(
         self, oid: str, cls: str, deadline_s: Optional[float] = None
     ) -> dict:

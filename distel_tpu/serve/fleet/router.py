@@ -68,6 +68,8 @@ _ROUTES = (
      "/v1/ontologies"),
     ("POST", re.compile(r"^/v1/ontologies/([^/]+)/deltas/?$"), "delta",
      "/v1/ontologies/{id}/deltas"),
+    ("POST", re.compile(r"^/v1/ontologies/([^/]+)/retract/?$"), "retract",
+     "/v1/ontologies/{id}/retract"),
     ("GET", re.compile(r"^/v1/ontologies/([^/]+)/subsumers/?$"),
      "proxy", "/v1/ontologies/{id}/subsumers"),
     ("GET", re.compile(r"^/v1/ontologies/([^/]+)/taxonomy/?$"),
@@ -232,7 +234,10 @@ class RouterApp:
             self._seq += 1
             return f"ont-{self._seq:04d}"
 
-    def _journal_append(self, oid: str, text: str) -> None:
+    def _journal_append(self, oid: str, text) -> None:
+        """``text``: a plain add text, or a retraction op marker
+        (``{"op": "retract", "text": ...}``) — the journal is an op
+        log, replayed in order by adopt-from-journal recovery."""
         with self._journal_lock:
             self._journal.setdefault(oid, []).append(text)
 
@@ -374,6 +379,20 @@ class RouterApp:
             oid, "POST", path, body, deadline_s
         )
         self._journal_append(oid, text)
+        return status, ctype, out
+
+    def _ep_retract(self, oid, *, query, body, deadline_s, path):
+        doc = _json_doc(body)
+        text = doc.get("text")
+        if not isinstance(text, str) or not text.strip():
+            raise HTTPError(400, 'body must be {"text": "<axioms>"}')
+        status, ctype, out = self._proxy_oid(
+            oid, "POST", path, body, deadline_s
+        )
+        # journal the retraction as an op marker: crash-recovery replay
+        # (adopt from journal) applies the log in order, so the retract
+        # resolves against the adds before it
+        self._journal_append(oid, {"op": "retract", "text": text})
         return status, ctype, out
 
     def _ep_proxy(self, oid, *, query, body, deadline_s, path):

@@ -316,9 +316,84 @@ class OntologyRegistry:
             # ontology than the one the closure answers for
             onto = owl_loader.load(text)
             entry.texts.append(text)
-            inc.add_ontology(onto)
+            inc.add_ontology(onto, source_text=text)
             rec = self._commit_delta(oid, entry, inc, len(texts))
         self.traffic.note_write(oid)
+        self._note_path(inc)
+        self._maybe_evict(keep=oid)
+        return rec
+
+    def retract(self, oid: str, text: str) -> dict:
+        """Retract a previously-applied text and commit the DRed-repaired
+        closure (``core/retract.py`` — ISSUE 16).  Rides the scheduler's
+        per-ontology lane like a delta but NEVER cohorts: retraction is
+        submitted non-batchable (``kind="retract"``), so the cohort
+        formation lane — which only groups batchable deltas — falls back
+        solo by construction; the flight event says so loudly.
+
+        The op-log entry (``{"op": "retract", "text": ...}``) is appended
+        to ``entry.texts`` only after the repair commits: on a mid-repair
+        failure the classifier's packed state is consumed (the next
+        increment re-derives the survivors from scratch) while
+        ``last_result`` still answers for the PRE-retract corpus the
+        un-appended text log describes — spill/restore stays consistent
+        either way.
+
+        The repaired snapshot always publishes under a NEW version —
+        bypassing the no-op republish skip on purpose: a repair can
+        derive zero new bits yet still shrink ``original_classes``
+        (dead concepts leave the taxonomy), which the skip's
+        closure-only check cannot see.  Pre-repair versions keep
+        serving reads until the swap; ``min_version`` semantics are
+        unchanged."""
+        from distel_tpu.core.retract import RetractionError
+
+        entry = self._entry(oid)
+        t0 = time.monotonic()
+        with entry.lock:
+            self._check_live(entry)
+            inc = self._resident(entry)
+            try:
+                with obs_trace.child_span(
+                    "registry.retract", {"oid": oid}
+                ):
+                    inc.retract(text)
+            except RetractionError as e:
+                self._count("distel_retract_refused_total")
+                self._event(
+                    "retract_refused",
+                    oid=oid,
+                    reason=type(e).__name__,
+                )
+                raise
+            entry.texts.append({"op": "retract", "text": text})
+            entry.resident_bytes = _state_bytes(inc)
+            entry.last_used = time.monotonic()
+            version = None
+            if self.query is not None and inc.last_result is not None:
+                version = self.query.publish_result(
+                    oid, inc.last_result, at_least=inc.increment
+                ).version
+            rec = dict(inc.history[-1])
+            rec.update(
+                id=oid,
+                concepts=inc.last_result.idx.n_concepts,
+            )
+            if version is not None:
+                rec["version"] = version
+        wall = time.monotonic() - t0
+        self.traffic.note_write(oid)
+        self._count("distel_retract_total")
+        if self.metrics is not None:
+            self.metrics.observe("distel_retract_repair_seconds", wall)
+        self._event(
+            "retract",
+            oid=oid,
+            rows=rec.get("retracted_rows"),
+            affected=rec.get("affected_concepts"),
+            cohort="solo",  # retracts never form/join cohorts
+            wall_s=round(wall, 4),
+        )
         self._note_path(inc)
         self._maybe_evict(keep=oid)
         return rec
@@ -395,7 +470,7 @@ class OntologyRegistry:
                     entry.texts.append(text)
                     inc.last_compile = None
                     inc.last_delta_stats = None
-                    idx, batch = inc._ingest(onto)
+                    idx, batch = inc._ingest(onto, source_text=text)
                     plan = inc._delta_fast_plan(idx, cohort_shape=True)
                     rec = (oid, entry, inc, plan, batch, idx, len(texts))
                     if plan is not None and cohort_mod.delta_cohort_ready(
@@ -578,8 +653,24 @@ class OntologyRegistry:
                     if warm:
                         self._resident(entry)
                 else:
+                    # crash-recovery replay: a pure-add log still joins
+                    # into ONE increment (the historical fast path); a
+                    # log with retraction markers ({"op": "retract"})
+                    # must replay IN ORDER — a retract only resolves
+                    # against the exact add text before it
                     inc = self._new_inc()
-                    inc.add_text("\n".join(texts))
+                    if not any(isinstance(op, dict) for op in texts):
+                        inc.add_text("\n".join(texts))
+                    else:
+                        for op in texts:
+                            if isinstance(op, dict):
+                                if op.get("op") != "retract":
+                                    raise ValueError(
+                                        f"unknown op-log entry: {op!r}"
+                                    )
+                                inc.retract(op["text"])
+                            else:
+                                inc.add_text(op)
                     entry.inc = inc
                     entry.texts = list(texts)
                     entry.resident_bytes = _state_bytes(inc)

@@ -41,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from distel_tpu.config import ClassifierConfig
+from distel_tpu.core.retract import RetractionError, UnknownRetraction
 from distel_tpu.obs import trace as obs_trace
 from distel_tpu.obs.flight import FlightRecorder
 from distel_tpu.obs.trace import SpanRecorder, TraceContext, chrome_trace
@@ -73,6 +74,8 @@ _ROUTES = (
      "/v1/ontologies"),
     ("POST", re.compile(r"^/v1/ontologies/([^/]+)/deltas/?$"), "delta",
      "/v1/ontologies/{id}/deltas"),
+    ("POST", re.compile(r"^/v1/ontologies/([^/]+)/retract/?$"), "retract",
+     "/v1/ontologies/{id}/retract"),
     ("GET", re.compile(r"^/v1/ontologies/([^/]+)/subsumers/?$"),
      "subsumers", "/v1/ontologies/{id}/subsumers"),
     ("GET", re.compile(r"^/v1/ontologies/([^/]+)/taxonomy/?$"),
@@ -273,6 +276,21 @@ class ServeApp:
         self.metrics.describe(
             "distel_saturation_rebuilds_total",
             "increments that compiled a fresh engine",
+        )
+        # ---- retraction plane (ISSUE 16): DRed delete-and-rederive
+        self.metrics.describe(
+            "distel_retract_total",
+            "retractions committed (DRed repair published)",
+        )
+        self.metrics.describe(
+            "distel_retract_refused_total",
+            "retractions refused (unknown text, entangled gensyms, or "
+            "active range machinery)",
+        )
+        self.metrics.describe(
+            "distel_retract_repair_seconds",
+            "per-retraction delete-and-rederive wall (overdelete + "
+            "repair saturation + snapshot publish)",
         )
         self.metrics.gauge_fn(
             "distel_queue_depth", self.scheduler.depth
@@ -636,6 +654,9 @@ class ServeApp:
             if kind == "delta":
                 with timer.phase("delta"):
                     return self.registry.delta(key, payloads)
+            if kind == "retract":
+                with timer.phase("retract"):
+                    return self.registry.retract(key, payloads[0])
             if kind == "subsumers":
                 with timer.phase("query"):
                     return self._subsumers(key, payloads[0])
@@ -727,6 +748,12 @@ class ServeApp:
             raise HTTPError(503, str(e))
         except UnknownOntology as e:
             raise HTTPError(404, f"unknown ontology {e.args[0]!r}")
+        except UnknownRetraction as e:
+            raise HTTPError(404, str(e))
+        except RetractionError as e:
+            # entangled/range-blocked retraction: the request conflicts
+            # with the ontology's current state, not a malformed ask
+            raise HTTPError(409, str(e))
         except HTTPError:
             raise
         except Exception as e:
@@ -749,6 +776,15 @@ class ServeApp:
     def _ep_delta(self, oid, *, query, body, deadline_s):
         text = self._json_text(body)
         rec = self._schedule(oid, "delta", text, deadline_s, batchable=True)
+        return 200, "application/json", _dumps(rec)
+
+    def _ep_retract(self, oid, *, query, body, deadline_s):
+        # NOT batchable: a retract must not coalesce with neighboring
+        # deltas (order against the adds it follows is the contract)
+        # and the cohort lane only forms over batchable deltas — so a
+        # retract always executes solo on its ontology's lane
+        text = self._json_text(body)
+        rec = self._schedule(oid, "retract", text, deadline_s)
         return 200, "application/json", _dumps(rec)
 
     def _ep_subsumers(self, oid, *, query, body, deadline_s):

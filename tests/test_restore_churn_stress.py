@@ -16,6 +16,14 @@ against the CPU oracle EVERY round — the bisectable repro the
 root-cause item needs (run it at a suspect commit; first wrong round
 prints its full context).
 
+PR 16 update: the flake's likely root cause is state-buffer DONATION
+on PJRT-CPU (``donate_argnums`` on the run/sparse/observe programs
+recycling aliased pages while host reads are pending), fixed by
+``rowpacked_engine._state_donation()`` — see the ROADMAP item.  This
+harness remains the repro path: set ``DISTEL_DONATE_RUN_STATE=1`` to
+re-enable donation and reproduce the old behaviour under
+``MALLOC_PERTURB_=42``.
+
 Run:  ``pytest -m slow tests/test_restore_churn_stress.py -q``
 Tune: ``DISTEL_STRESS_ROUNDS`` (default 24),
       ``DISTEL_STRESS_CACHE_CAPACITY`` (default 2 — the pinch; the
@@ -151,5 +159,84 @@ def test_registry_spill_restore_closure_under_churn(tmp_path):
             assert after == before, (
                 f"{ctx}: taxonomy changed across spill/restore"
             )
+    finally:
+        PROGRAMS.capacity = cap0
+
+
+@pytest.mark.slow
+def test_serve_query_layer_churn(tmp_path):
+    """Serve/query-layer extension of the churn loop (ISSUE 16): each
+    round drives the full registry + snapshot-plane cycle — load,
+    delta, retract, evict-spill, reload — under the same pinched-
+    PROGRAMS churn, asserting after every step that (a) the lock-free
+    snapshot plane answers byte-identically to the scheduler-lane
+    taxonomy, and (b) published snapshot versions only move forward
+    (a retract repair must publish a NEW version, never recycle the
+    pre-repair snapshot).  ``DISTEL_STRESS_SERVE_LAYERS=0`` skips the
+    loop (same knob family as ``DISTEL_STRESS_ROUNDS`` /
+    ``DISTEL_STRESS_CACHE_CAPACITY``, which it also honors)."""
+    import json
+
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.taxonomy import extract_taxonomy
+    from distel_tpu.serve.query.snapshot import SnapshotStore
+    from distel_tpu.serve.registry import OntologyRegistry
+
+    if os.environ.get("DISTEL_STRESS_SERVE_LAYERS", "1") == "0":
+        pytest.skip("DISTEL_STRESS_SERVE_LAYERS=0")
+    rounds = max(int(os.environ.get("DISTEL_STRESS_ROUNDS", "24")) // 3, 2)
+    pinch = int(os.environ.get("DISTEL_STRESS_CACHE_CAPACITY", "2"))
+    roster = _corpora()
+    cap0 = PROGRAMS.capacity
+    PROGRAMS.capacity = max(pinch, 1)
+    store = SnapshotStore()
+    reg = OntologyRegistry(
+        ClassifierConfig(), spill_dir=str(tmp_path),
+        fast_path_min_concepts=0, query=store,
+    )
+
+    def tax(oid):
+        return extract_taxonomy(reg.classifier(oid).last_result)
+
+    def check_planes(oid, ctx):
+        t = tax(oid)
+        snap = store.get(oid)
+        for cls in list(t.subsumers)[:8]:
+            assert sorted(snap.subsumers(cls)) == sorted(
+                t.subsumers[cls]
+            ), f"{ctx}: snapshot plane diverged for {cls}"
+
+    try:
+        for r in range(rounds):
+            n, text, _norm, _idx = roster[r % len(roster)]
+            ctx = f"round {r} corpus {n} (evictions {PROGRAMS.evictions})"
+            oid = reg.new_id()
+            # range elimination makes span provenance unattributable,
+            # so the retraction gate refuses range-bearing corpora —
+            # this loop exercises the retract path, so it runs the
+            # roster shape minus its ObjectPropertyRange axiom
+            reg.load(oid, "\n".join(
+                line for line in text.splitlines()
+                if not line.startswith("ObjectPropertyRange")
+            ))
+            v = store.get(oid).version
+            check_planes(oid, ctx + " post-load")
+            doomed = f"SubClassOf(Churn{r}A Churn{r}B)"
+            reg.delta(oid, [doomed])
+            assert store.get(oid).version > v, f"{ctx}: delta republish"
+            v = store.get(oid).version
+            reg.retract(oid, doomed)
+            assert store.get(oid).version > v, (
+                f"{ctx}: retract repair must publish a NEW snapshot"
+            )
+            check_planes(oid, ctx + " post-retract")
+            pinned = json.dumps(tax(oid).parents, sort_keys=True)
+            entry = reg._entries[oid]
+            with entry.lock:
+                reg._spill(entry)
+            assert json.dumps(
+                tax(oid).parents, sort_keys=True
+            ) == pinned, f"{ctx}: taxonomy changed across evict-reload"
+            check_planes(oid, ctx + " post-reload")
     finally:
         PROGRAMS.capacity = cap0

@@ -1,0 +1,333 @@
+"""Retraction-engine tests (ISSUE 16): DRed delete-and-rederive parity
+against the from-scratch oracle across rule families (CR5/bottom
+propagation, CR6 role chains), randomized add/retract sequences,
+refusal semantics (unknown text, entangled gensyms, active range
+machinery), the zero-compile steady-state repair contract, the serve
+plane's first-class ``retract`` op (HTTP, metrics, solo-cohort flight
+event), and the traffic-trace record/replay round trip."""
+
+import contextlib
+import json
+import random
+import threading
+
+import pytest
+
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.core.retract import (
+    EntangledRetraction,
+    RetractionError,
+    UnknownRetraction,
+)
+from distel_tpu.runtime.taxonomy import extract_taxonomy
+from distel_tpu.serve.client import ServeClient, ServeError
+from distel_tpu.serve.server import ServeApp, make_server
+from distel_tpu.serve.traces import (
+    TraceError,
+    TraceRecorder,
+    load_trace,
+    replay_trace,
+)
+
+
+def _tax_key(result) -> str:
+    """Byte-comparable taxonomy fingerprint: parents + equivalents +
+    unsatisfiable — the full classification answer surface."""
+    tax = extract_taxonomy(result)
+    return json.dumps(
+        {
+            "parents": tax.parents,
+            "equivalents": tax.equivalents,
+            "unsatisfiable": tax.unsatisfiable,
+        },
+        sort_keys=True,
+    )
+
+
+def _oracle_key(texts) -> str:
+    inc = IncrementalClassifier()
+    for t in texts:
+        inc.add_text(t)
+    return _tax_key(inc.last_result)
+
+
+def _classify_texts(texts):
+    inc = IncrementalClassifier()
+    for t in texts:
+        inc.add_text(t)
+    return inc
+
+
+# --------------------------------------------------- rule-family parity
+
+
+def test_retract_parity_cr5_bottom():
+    """Retracting the axioms that made classes unsatisfiable must
+    resurrect them — CR5/bottom propagation bits are cleared and NOT
+    re-derived from the survivors."""
+    base = (
+        "SubClassOf(A B)\n"
+        "SubClassOf(B ObjectSomeValuesFrom(r C))\n"
+        "DisjointClasses(D E)\n"
+    )
+    # the doomed delta drives A (via B) into bottom: C becomes
+    # unsatisfiable and CR5 propagates owl:Nothing up the r-edge
+    doomed = "SubClassOf(C D)\nSubClassOf(C E)\n"
+    inc = _classify_texts([base, doomed])
+    assert "C" in extract_taxonomy(inc.last_result).unsatisfiable
+    inc.retract(doomed)
+    assert _tax_key(inc.last_result) == _oracle_key([base])
+    assert extract_taxonomy(inc.last_result).unsatisfiable == []
+
+
+def test_retract_parity_cr6_role_chain():
+    """CR6: retracting the link text that fired a role chain must
+    remove the chain-derived subsumptions, including the transitive
+    compositions the repair must not resurrect."""
+    base = (
+        "SubObjectPropertyOf(ObjectPropertyChain(r s) r)\n"
+        "SubClassOf(A ObjectSomeValuesFrom(r B))\n"
+        "SubClassOf(ObjectSomeValuesFrom(r C) Hit)\n"
+    )
+    doomed = "SubClassOf(B ObjectSomeValuesFrom(s C))\n"
+    inc = _classify_texts([base, doomed])
+    # chain fired: A --r--> B --s--> C composes to A --r--> C ⇒ A ⊑ Hit
+    assert "Hit" in extract_taxonomy(inc.last_result).subsumers["A"]
+    inc.retract(doomed)
+    assert _tax_key(inc.last_result) == _oracle_key([base])
+    assert "Hit" not in extract_taxonomy(inc.last_result).subsumers["A"]
+
+
+def test_retract_randomized_sequences_match_oracle():
+    """Randomized add/retract interleavings across every rule family:
+    after each retraction the taxonomy must be byte-identical to a
+    from-scratch classify of exactly the surviving texts."""
+    pool = [
+        "SubClassOf(P0 P1)\nSubClassOf(P1 P2)\n",
+        "SubClassOf(P3 ObjectSomeValuesFrom(u P0))\n",
+        "SubClassOf(ObjectSomeValuesFrom(u P2) P4)\n",
+        "SubObjectPropertyOf(ObjectPropertyChain(u v) u)\n"
+        "SubClassOf(P0 ObjectSomeValuesFrom(v P3))\n",
+        "EquivalentClasses(P5 ObjectIntersectionOf(P1 P4))\n",
+        "DisjointClasses(P2 P6)\n",
+        "SubClassOf(P7 P6)\nSubClassOf(P7 ObjectSomeValuesFrom(v P1))\n",
+    ]
+    base = "SubClassOf(Seed0 Seed1)\n"
+    for seed in (0, 1):
+        rng = random.Random(seed)
+        inc = IncrementalClassifier()
+        inc.add_text(base)
+        live = [base]
+        checked = 0
+        for _ in range(12):
+            # bias toward adds until most of the pool is in, then churn
+            addable = [t for t in pool if t not in live]
+            retractable = live[1:]  # keep the seed text resident
+            if addable and (not retractable or rng.random() < 0.55):
+                t = rng.choice(addable)
+                inc.add_text(t)
+                live.append(t)
+            else:
+                t = rng.choice(retractable)
+                try:
+                    inc.retract(t)
+                except EntangledRetraction:
+                    continue  # legal refusal: nothing mutated
+                live.remove(t)
+                assert _tax_key(inc.last_result) == _oracle_key(live), (
+                    f"seed {seed}: divergence after retracting {t!r} "
+                    f"with live set {live}"
+                )
+                checked += 1
+        assert checked >= 2, f"seed {seed}: sequence never retracted"
+        assert _tax_key(inc.last_result) == _oracle_key(live)
+
+
+# ---------------------------------------------------------- refusals
+
+
+def test_retract_unknown_text_refused():
+    inc = _classify_texts(["SubClassOf(A B)"])
+    with pytest.raises(UnknownRetraction):
+        inc.retract("SubClassOf(Never Added)")
+    # retracting the same text twice: second is unknown
+    extra = "SubClassOf(C A)"
+    inc.add_text(extra)
+    inc.retract(extra)
+    with pytest.raises(UnknownRetraction):
+        inc.retract(extra)
+
+
+def test_retract_entangled_gensym_refused():
+    """Two ingests normalizing the same nested filler share a memoized
+    gensym (a plain atomic-filler existential needs none) — retracting
+    either must refuse (one side's rows reference the other ingest's
+    gensym), and refuse WITHOUT mutating."""
+    shared = "ObjectSomeValuesFrom(r ObjectIntersectionOf(D E))"
+    inc = _classify_texts([f"SubClassOf(A {shared})"])
+    inc.add_text(f"SubClassOf(B {shared})")
+    before = _tax_key(inc.last_result)
+    with pytest.raises(EntangledRetraction):
+        inc.retract(f"SubClassOf(B {shared})")
+    with pytest.raises(EntangledRetraction):
+        inc.retract(f"SubClassOf(A {shared})")
+    assert _tax_key(inc.last_result) == before
+    assert all(not rec["retracted"] for rec in inc._ingests)
+
+
+def test_retract_range_machinery_refused():
+    """Active range elimination re-emits rows for OLD axioms into later
+    batches, breaking span provenance — any retract must refuse."""
+    inc = _classify_texts(
+        [
+            "ObjectPropertyRange(r B)\n"
+            "SubClassOf(A ObjectSomeValuesFrom(r C))\n"
+        ]
+    )
+    extra = "SubClassOf(D A)"
+    inc.add_text(extra)
+    with pytest.raises(EntangledRetraction):
+        inc.retract(extra)
+
+
+# ------------------------------------------- steady-state repair cost
+
+
+def test_steady_state_repair_compiles_nothing():
+    """Ids are append-only and survivors are a subset, so the repair's
+    engine lands in the SAME shape bucket as the increment it undoes:
+    the rebuild must be a program-registry hit with zero compile."""
+    base = "\n".join(
+        f"SubClassOf(C{i} C{i + 1})" for i in range(40)
+    ) + "\nSubClassOf(C0 ObjectSomeValuesFrom(r C5))\n"
+    inc = _classify_texts([base])
+    doomed = (
+        "SubClassOf(X0 C3)\n"
+        "SubClassOf(X0 ObjectSomeValuesFrom(r X1))\n"
+    )
+    inc.add_text(doomed)
+    inc.retract(doomed)
+    rec = inc.history[-1]
+    assert rec["path"] == "retract"
+    assert rec["compile_s"] == 0.0, f"repair compiled: {rec}"
+    assert rec["program_cache_hit"] is True
+    assert _tax_key(inc.last_result) == _oracle_key([base])
+    # re-adding the same text after the memo purge re-mints the
+    # gensym and re-derives — ending byte-identical to never-retracted
+    inc.add_text(doomed)
+    assert _tax_key(inc.last_result) == _oracle_key([base, doomed])
+
+
+# ------------------------------------------------------- serve plane
+
+
+@contextlib.contextmanager
+def serving(**kw):
+    app = ServeApp(**kw)
+    server = make_server(app)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    port = server.server_address[1]
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=300)
+    try:
+        yield app, client
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close(final_spill=False)
+        thread.join(timeout=10)
+
+
+def test_serve_retract_end_to_end(tmp_path):
+    base = "SubClassOf(A B)\nSubClassOf(B C)\n"
+    doomed = "SubClassOf(New0 A)\nSubClassOf(New0 ObjectSomeValuesFrom(r C))\n"
+    with serving(
+        workers=1, fast_path_min_concepts=0, spill_dir=str(tmp_path)
+    ) as (app, client):
+        oid = client.load(base)["id"]
+        v_pre = client.delta(oid, doomed)["version"]
+        rec = client.retract(oid, doomed)
+        assert rec["path"] == "retract"
+        assert rec["version"] > v_pre
+        # post-retract taxonomy == from-scratch classify of survivors
+        oracle = _classify_texts([base])
+        assert client.taxonomy(oid)["parents"] == extract_taxonomy(
+            oracle.last_result
+        ).parents
+        # the pre-repair snapshot plane kept serving: a min_version
+        # read at the PRE-retract watermark succeeds post-repair
+        # (versions only move forward)
+        doc = client._request(
+            "GET",
+            f"/v1/ontologies/{oid}/query/version?min_version={v_pre}",
+        )
+        assert doc["version"] >= v_pre
+        # unknown text refuses with 404, entangled reasons with 409
+        with pytest.raises(ServeError) as e404:
+            client.retract(oid, "SubClassOf(Never Here)")
+        assert e404.value.status == 404
+        # metrics: committed + refused counters, repair histogram
+        mtext = client.metrics_text()
+        assert "distel_retract_total 1" in mtext
+        assert "distel_retract_refused_total 1" in mtext
+        assert "distel_retract_repair_seconds_count 1" in mtext
+        # solo-cohort loudness: the flight event says the retract ran
+        # outside any cohort, and no cohort ever formed
+        evs = app.flight.events(kind="retract")
+        assert evs and evs[-1]["cohort"] == "solo"
+        for line in mtext.splitlines():
+            if line.startswith("distel_cohort_formed_total"):
+                assert line.rsplit(" ", 1)[1] == "0"
+
+
+# ----------------------------------------------------- traffic traces
+
+
+def test_trace_record_replay_roundtrip(tmp_path):
+    """Record a mixed add/retract/query stream, save, reload, replay
+    against a live server: zero failed requests and the retraction is
+    visible in the replayed server's taxonomy."""
+    rec = TraceRecorder()
+    base = "SubClassOf(A B)\nSubClassOf(B C)\n"
+    doomed = "SubClassOf(Gone A)\n"
+    rec.record("load", "t1", text=base)
+    rec.record("add", "t1", text=doomed)
+    rec.record("query", "t1", kind="subsumers", **{"class": "Gone"})
+    rec.record("retract", "t1", text=doomed)
+    rec.record("query", "t1", kind="taxonomy")
+    rec.record("migrate", "t1")
+    path = str(tmp_path / "roundtrip.jsonl")
+    rec.save(path)
+    events = load_trace(path)
+    assert [e["op"] for e in events] == [
+        "load", "add", "query", "retract", "query", "migrate",
+    ]
+    with serving(
+        workers=1, fast_path_min_concepts=0, spill_dir=str(tmp_path)
+    ) as (_app, client):
+        out = replay_trace(events, client)
+        assert out["failed_requests"] == 0, out
+        assert out["skipped_migrates"] == 1
+        oid = out["ontologies"]["t1"]
+        assert "Gone" not in client.taxonomy(oid)["parents"]
+
+
+def test_trace_validation_refuses_bad_lines(tmp_path):
+    def attempt(lines):
+        p = tmp_path / "bad.jsonl"
+        p.write_text("\n".join(lines) + "\n")
+        with pytest.raises(TraceError):
+            load_trace(str(p))
+
+    attempt(['{"t": 0, "op": "zap", "ont": "o"}'])  # unknown op
+    attempt(['{"t": 0, "op": "add", "ont": "o"}'])  # missing text
+    attempt(['{"t": 0, "op": "query", "ont": "o", "kind": "wat"}'])
+    attempt(  # subsumers without a class
+        ['{"t": 0, "op": "query", "ont": "o", "kind": "subsumers"}']
+    )
+    attempt([  # time travel
+        '{"t": 5, "op": "load", "ont": "o", "text": "SubClassOf(A B)"}',
+        '{"t": 1, "op": "query", "ont": "o", "kind": "taxonomy"}',
+    ])
+    attempt(["not json at all"])
+    attempt(["# only comments"])  # empty after stripping
