@@ -179,6 +179,22 @@ class ClassifierConfig:
     pipeline: bool = True
     #: maximum speculatively in-flight observed rounds (1 = synchronous)
     pipeline_depth: int = 2
+    #: device-resident fused rounds (rowpacked engine, observed runs,
+    #: single-device and mesh): with ``fused_rounds_k`` > 1 the round
+    #: loop itself moves onto the device — one dispatch runs up to K
+    #: rounds of the adaptive controller in a ``lax.while_loop`` (the
+    #: dense/sparse tier pick, density/hysteresis and convergence
+    #: tests all on device) and the host pays its per-round work
+    #: (dispatch, frontier fold, ledger/observer callbacks) once per
+    #: WINDOW — the reference's per-iteration barrier cost amortized
+    #: K×.  Byte-identical per retired round to the per-round
+    #: controllers; a round overflowing the window's traced sparse
+    #: workspace falls out to the per-round path and never drops work.
+    fused_rounds: bool = True
+    #: rounds per fused window (K).  1 = the per-round controllers
+    #: (the fused program is never built); raise on hosts where the
+    #: per-round host round-trip dominates the retire wall.
+    fused_rounds_k: int = 1
     #: serve fleet (``serve/fleet/``): replica processes behind the
     #: router — shared-nothing scale-out of the serve plane (the
     #: reference's NODES_LIST, but processes on one host instead of
@@ -361,6 +377,12 @@ class ClassifierConfig:
             cfg.pipeline = raw["pipeline.enable"].lower() == "true"
         if "pipeline.depth" in raw:
             cfg.pipeline_depth = int(raw["pipeline.depth"])
+        if "fused.rounds.enable" in raw:
+            cfg.fused_rounds = (
+                raw["fused.rounds.enable"].lower() == "true"
+            )
+        if "fused.rounds.k" in raw:
+            cfg.fused_rounds_k = int(raw["fused.rounds.k"])
         if "fleet.replicas" in raw:
             cfg.fleet_replicas = int(raw["fleet.replicas"])
         if "fleet.depth.divergence" in raw:
@@ -463,6 +485,17 @@ class ClassifierConfig:
         return {
             "enable": self.pipeline,
             "depth": self.pipeline_depth,
+        }
+
+    def fused_rounds_config(self) -> Optional[dict]:
+        """The rowpacked engine's ``fused_rounds=`` kwarg for this
+        config (None = per-round controllers; the engine also routes
+        per-round when K resolves to 1)."""
+        if not self.fused_rounds:
+            return None
+        return {
+            "enable": True,
+            "rounds": self.fused_rounds_k,
         }
 
     def tracer_kwargs(self) -> dict:

@@ -49,6 +49,7 @@ import numpy as np
 from jax import lax
 
 from distel_tpu.core.indexing import BOTTOM_ID, TOP_ID, IndexedOntology
+from distel_tpu.runtime.instrumentation import DISPATCH_EVENTS
 
 
 class SaturationState(NamedTuple):
@@ -294,6 +295,7 @@ def observed_loop(
                     handle = latest = pool.submit(_run)
                 dispatch_s = _time.perf_counter() - t0
                 dispatched += unroll
+                DISPATCH_EVENTS.record_dense()
                 pending.append((dispatched, handle, dispatch_s))
             if not pending:
                 break  # budget exhausted without convergence

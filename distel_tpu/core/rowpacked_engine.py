@@ -98,6 +98,7 @@ from distel_tpu.ops.bitpack import (
 )
 from distel_tpu.runtime.instrumentation import (
     COHORT_EVENTS,
+    DISPATCH_EVENTS,
     FRONTIER_EVENTS,
     CompileStats,
     FrontierStats,
@@ -346,6 +347,7 @@ class RowPackedSaturationEngine:
         sparse_tail: Optional[dict] = None,
         pipeline: Optional[dict] = None,
         cr6_tiles: Optional[dict] = None,
+        fused_rounds: Optional[dict] = None,
     ):
         """``rules``: subset of {"CR1".."CR6"} this engine applies (None =
         all) — the per-rule backend plugin boundary: rules routed to
@@ -1644,6 +1646,15 @@ class RowPackedSaturationEngine:
         self._sparse_builds: list = []
         self._sparse_const_cache = None
         self._sparse_mm: dict = {}
+        #: fused multi-round tier state (ISSUE 17): normalized window
+        #: config, per-(K, capacity) AOT executables, build telemetry,
+        #: and the cached device plan tables of the on-device round
+        #: decision (dropped by rebind_role_closure — the factored
+        #: masks and live-window validity change under a grown closure)
+        self._fused_cfg = self._normalize_fused_cfg(fused_rounds)
+        self._aot_fused: dict = {}
+        self._fused_builds: list = []
+        self._fused_tab_cache = None
         self.frontier_rounds: list = []
         self._stats_lock = threading.Lock()
         #: accumulated program-build telemetry for this engine
@@ -2125,6 +2136,50 @@ class RowPackedSaturationEngine:
         cfg["depth"] = int(cfg["depth"])
         cfg["enable"] = bool(cfg["enable"])
         return cfg
+
+    _FUSED_DEFAULTS = {"enable": True, "rounds": 1}
+
+    @classmethod
+    def _normalize_fused_cfg(cls, raw) -> Optional[dict]:
+        """Resolved device-resident fused-rounds config (ISSUE 17).
+        ``rounds`` (K) is the surfacing period: the observed controller
+        runs up to K saturation rounds inside ONE device dispatch — a
+        ``lax.while_loop`` with the tier decision on device — and
+        surfaces to the host only at window edges.  ``None``/``True``
+        mean the DEFAULTS (K=1: the per-round controllers run
+        untouched, so the posture is behavior-preserving by default);
+        K>1 turns the fused window path on wherever the adaptive
+        controller would run.  Returns None when disabled."""
+        if raw is None or raw is True:
+            return dict(cls._FUSED_DEFAULTS)
+        if raw is False:
+            return None
+        cfg = dict(cls._FUSED_DEFAULTS)
+        unknown = set(raw) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown fused_rounds keys: {sorted(unknown)}")
+        cfg.update(raw)
+        if not cfg["enable"]:
+            return None
+        if int(cfg["rounds"]) < 1:
+            raise ValueError(
+                f"fused_rounds rounds must be >= 1 (got {cfg['rounds']!r})"
+            )
+        cfg["rounds"] = int(cfg["rounds"])
+        return cfg
+
+    def _fused_eligible(self) -> bool:
+        """Whether this engine's config actually routes the fused
+        multi-round tier (K > 1 configured AND the adaptive sparse-tail
+        machinery the on-device round decision is built from is both
+        configured and supported) — the precompile roster warms the
+        fused window program only then."""
+        return bool(
+            self._fused_cfg
+            and self._fused_cfg["rounds"] > 1
+            and self._sparse_cfg is not None
+            and self._sparse_supported()
+        )
 
     def _sparse_supported(self) -> bool:
         """The tier's support matrix: CR4/CR6 — when present — must be
@@ -2687,6 +2742,494 @@ class RowPackedSaturationEngine:
         self._note_compile(stats)
         return exe
 
+    # ------------------------------------ fused multi-round tier (ISSUE 17)
+    #
+    # The per-round controllers pay a host dispatch + frontier fold per
+    # retired round — the analog of the reference's per-iteration Redis
+    # barrier (``controller/CommunicationHandler.java:78-83``).  The
+    # fused tier moves the ROUND LOOP itself onto the device: one
+    # ``lax.while_loop`` runs up to K rounds per dispatch, re-deriving
+    # the adaptive controller's per-round decision (frontier measure,
+    # density/hysteresis, dense-vs-sparse tier pick, convergence vote)
+    # from device-resident copies of the same carries, and surfaces to
+    # the host only at window edges.  Every decision input rides as a
+    # runtime argument (the device analog of ``_sparse_round_plan``'s
+    # host tables), so the fused program is a pure function of
+    # ``bucket_signature`` + K + the traced sparse workspace capacities
+    # and shares executables through PROGRAMS like every other program.
+    # A round whose frontier overflows the traced capacity rung EXITS
+    # the window before running (status 2) and replays on the host path
+    # — fused runs stay byte-identical per retired round to the
+    # synchronous adaptive controller, the property
+    # tests/test_fused_rounds.py pins.
+
+    def _fused_below_cutoff(self, thr: float) -> int:
+        """Largest ``rows_touched`` for which the HOST controller's f64
+        test ``rows / max(total_rows, 1) < thr`` holds — the exact
+        integer form of the density test the fused program evaluates on
+        device (an on-device f32 division could disagree with the host
+        at the threshold boundary, silently desyncing hysteresis)."""
+        total = max(self._sp_total_rows, 1)
+        start = int(np.floor(float(thr) * total)) + 2
+        for cand in range(start, -1, -1):
+            if cand / total < thr:
+                return cand
+        return -1
+
+    def _fused_tables(self) -> dict:
+        """Device-resident plan tables of the on-device round decision —
+        the runtime-argument analog of :meth:`_sparse_round_plan`'s host
+        arrays (rule tables, factored role masks, slab positions,
+        live-window validity).  Cached per engine;
+        ``rebind_role_closure`` drops the cache."""
+        fa = self._fused_tab_cache
+        if fa is not None:
+            return fa
+        i32 = np.int32
+        nf1, nf2, nf3 = self._sp_nf1, self._sp_nf2, self._sp_nf3
+        c = self._sparse_consts()
+        fa = {
+            "croles": jnp.asarray(self._chunk_roles_np),
+            "wmask": c["wmask"],
+            "fills": c["fills"],
+            "lroles": c["lroles"],
+        }
+        if len(nf1):
+            fa["nf1s"] = jnp.asarray(nf1[:, 0].astype(i32))
+            fa["nf1t"] = jnp.asarray(nf1[:, 1].astype(i32))
+        if len(nf2):
+            fa["nf2a"] = jnp.asarray(nf2[:, 0].astype(i32))
+            fa["nf2b"] = jnp.asarray(nf2[:, 1].astype(i32))
+            fa["nf2t"] = jnp.asarray(nf2[:, 2].astype(i32))
+        if len(nf3):
+            fa["nf3s"] = jnp.asarray(nf3[:, 0].astype(i32))
+            fa["nf3t"] = jnp.asarray(nf3[:, 1].astype(i32))
+
+        def row_tables(d, rows_src, mask_tab, prefix):
+            n = len(rows_src)
+            g_of = d.get("g_of")
+            if g_of is None:
+                g_of = np.zeros(d["nch"], np.int32)
+                for gi, (g0, g1, _p, _r) in enumerate(d["groups"]):
+                    g_of[g0:g1] = gi
+                d["g_of"] = g_of
+            fa["src" + prefix] = jnp.asarray(rows_src.astype(i32))
+            fa["m" + prefix] = jnp.asarray(mask_tab[:n])
+            fa["pos" + prefix] = jnp.asarray(
+                d["pos_of_row"][:n].astype(i32)
+            )
+            fa["gof" + prefix] = jnp.asarray(g_of)
+            # live-window validity per chunk — derived from tval_np,
+            # which rebind_role_closure refreshes (hence the cache drop)
+            fa["hw" + prefix] = jnp.asarray(d["tval_np"].any(axis=1))
+            fa["tgt" + prefix + "_flat"] = c["tgt" + prefix + "_flat"]
+
+        if self._scan4 is not None:
+            row_tables(self._scan4, np.asarray(self._a4), self._m4_full, "4")
+        if self._scan6 is not None:
+            row_tables(
+                self._scan6,
+                np.asarray(self._l26 // self.lc),
+                self._m6_full,
+                "6",
+            )
+        self._fused_tab_cache = fa
+        return fa
+
+    def _fused_run_args(self, cfg: dict, budget: int) -> dict:
+        """One run's full fused-program argument pytree: the cached
+        plan tables + this run's controller scalars + the engine's
+        dense-step argument pytree (slab leaves read live, so a rebind
+        between runs is picked up)."""
+        fa = dict(self._fused_tables())
+        if self._scan4 is not None:
+            fa["slabs4"] = self._scan4["slabs"]
+        if self._scan6 is not None:
+            fa["slabs6"] = self._scan6["slabs"]
+        fa["mk"] = self._masks
+        fa["below_cut"] = jnp.asarray(
+            self._fused_below_cutoff(cfg["density_threshold"]), jnp.int32
+        )
+        fa["hyst"] = jnp.asarray(int(cfg["hysteresis_rounds"]), jnp.int32)
+        fa["budget"] = jnp.asarray(int(budget), jnp.int32)
+        return fa
+
+    def _fused_round_plan_dev(self, sc, dl, fa):
+        """Device replica of :meth:`_sparse_round_plan`'s measure —
+        per-rule activity masks + counts over the full rule tables, no
+        compaction.  Must derive the IDENTICAL active sets the host
+        fold derives from the same carries: the per-round tier choice,
+        hysteresis and rows_touched records all hang off it."""
+        i32 = jnp.int32
+        nf1, nf2, nf3 = self._sp_nf1, self._sp_nf2, self._sp_nf3
+        zero = jnp.asarray(0, i32)
+        plan = {"n1": zero, "n2": zero, "n3": zero, "n4": zero, "n6": zero}
+
+        def scatter_or(base, tgts, act):
+            hit = (
+                jnp.zeros(self.nc, i32).at[tgts].max(act.astype(i32)) > 0
+            )
+            return base | hit
+
+        s1 = sc
+        if len(nf1):
+            act1 = sc[fa["nf1s"]]
+            plan["act1"] = act1
+            plan["n1"] = jnp.sum(act1, dtype=i32)
+            s1 = scatter_or(sc, fa["nf1t"], act1)
+        s2 = s1
+        if len(nf2):
+            act2 = s1[fa["nf2a"]] | s1[fa["nf2b"]]
+            plan["act2"] = act2
+            plan["n2"] = jnp.sum(act2, dtype=i32)
+            s2 = scatter_or(s1, fa["nf2t"], act2)
+        if len(nf3):
+            act3 = s2[fa["nf3s"]]
+            plan["act3"] = act3
+            plan["n3"] = jnp.sum(act3, dtype=i32)
+
+        dirty_roles = jnp.any(fa["croles"] & dl[:, None], axis=0)
+
+        def row_act(d, prefix, fd):
+            masked = jnp.any(fa["m" + prefix] & dirty_roles[None, :], axis=1)
+            pos = fa["pos" + prefix]
+            ok = (pos >= 0) & fa["hw" + prefix][
+                jnp.maximum(pos, 0) // d["rk"]
+            ]
+            return (fd | masked) & ok
+
+        if self._scan4 is not None:
+            fd4 = sc[fa["src4"]]
+            act4 = row_act(self._scan4, "4", fd4)
+            plan["fd4"], plan["act4"] = fd4, act4
+            plan["n4"] = jnp.sum(act4, dtype=i32)
+        if self._scan6 is not None:
+            fd6 = dl[fa["src6"]]
+            act6 = row_act(self._scan6, "6", fd6)
+            plan["fd6"], plan["act6"] = fd6, act6
+            plan["n6"] = jnp.sum(act6, dtype=i32)
+        any_r = jnp.any(dl)
+        rows = plan["n1"] + plan["n2"] + plan["n3"] + plan["n4"] + plan["n6"]
+        if self._bottom:
+            run5 = any_r | sc[BOTTOM_ID]
+            plan["run5"] = run5
+            rows = rows + run5.astype(i32)
+        plan["rows"] = rows
+        return plan
+
+    def _fused_sparse_args_dev(self, plan, dl, fa, caps):
+        """Device compaction of one round's active sets into the padded
+        sparse workspace — the traced analog of
+        :meth:`_sparse_round_args` (``jnp.nonzero(..., size, fill=0)``
+        matches ``np.flatnonzero``'s ascending order; pad slots carry
+        the host path's exact fills: index 0, val 0, wave -1)."""
+        c123, a4c, a6c = caps
+        i32, u32 = jnp.int32, jnp.uint32
+        full = jnp.asarray(0xFFFFFFFF, u32)
+
+        def compact(mask, n, cap):
+            idx = jnp.nonzero(mask, size=cap, fill_value=0)[0]
+            return idx, jnp.arange(cap) < n
+
+        def picked(tab, idx, valid, fill=0):
+            return jnp.where(valid, tab[idx], fill).astype(i32)
+
+        sa = {
+            "wmask": fa["wmask"],
+            "fills": fa["fills"],
+            "lroles": fa["lroles"],
+            "dirty_l": dl,
+        }
+        if len(self._sp_nf1):
+            idx, v = compact(plan["act1"], plan["n1"], c123)
+            sa["src1"] = picked(fa["nf1s"], idx, v)
+            sa["tgt1"] = picked(fa["nf1t"], idx, v)
+            sa["val1"] = jnp.where(v, full, jnp.asarray(0, u32))
+        if len(self._sp_nf2):
+            idx, v = compact(plan["act2"], plan["n2"], c123)
+            sa["src2a"] = picked(fa["nf2a"], idx, v)
+            sa["src2b"] = picked(fa["nf2b"], idx, v)
+            sa["tgt2"] = picked(fa["nf2t"], idx, v)
+            sa["val2"] = jnp.where(v, full, jnp.asarray(0, u32))
+        if len(self._sp_nf3):
+            idx, v = compact(plan["act3"], plan["n3"], c123)
+            sa["src3"] = picked(fa["nf3s"], idx, v)
+            sa["tgt3"] = picked(fa["nf3t"], idx, v)
+            sa["val3"] = jnp.where(v, full, jnp.asarray(0, u32))
+        if self._bottom:
+            sa["run5"] = plan["run5"]
+
+        def row_args(d, prefix, cap):
+            idx, v = compact(plan["act" + prefix], plan["n" + prefix], cap)
+            pos = picked(fa["pos" + prefix], idx, v)
+            sa["sel" + prefix] = pos
+            sa["fd" + prefix] = jnp.where(v, plan["fd" + prefix][idx], False)
+            sa["wave" + prefix] = jnp.where(
+                v, fa["gof" + prefix][pos // d["rk"]], -1
+            ).astype(i32)
+            sa["tgt" + prefix + "_flat"] = fa["tgt" + prefix + "_flat"]
+            sa["slabs" + prefix] = fa["slabs" + prefix]
+
+        if a4c and self._scan4 is not None:
+            row_args(self._scan4, "4", a4c)
+        if a6c and self._scan6 is not None:
+            row_args(self._scan6, "6", a6c)
+        return sa
+
+    def _fused_exec(
+        self, sp, rp, gate, dl, sc, below, it, fa, K, caps, axis_name=None,
+    ):
+        """Up to K rounds of the adaptive controller inside ONE traced
+        program — ``lax.while_loop`` with the tier decision on device.
+        Carries mirror the host controller exactly: the frontier
+        3-tuple (gate flags, per-L-chunk dirty, changed-S mask), the
+        hysteresis counter and the iteration cursor.  Per round the
+        body re-derives the host decision (plan → density/hysteresis →
+        idle / sparse / dense), executes the picked tier through the
+        SAME traced bodies the per-round programs use (``_step`` /
+        ``_sparse_exec``), and appends the round's telemetry to the
+        window buffers.  Exit status: 0 = K rounds retired (or budget
+        hit), 1 = converged, 2 = capacity fallout — the round's sparse
+        frontier overflowed the traced workspace ``caps`` and DID NOT
+        RUN; the host replays that one round on the per-round path and
+        resumes windows, so no work is ever dropped or double-run.
+
+        Under a mesh the body runs inside the engines' shard_map
+        structure: per-round psum folds (the dense step's frontier
+        fold, the sparse program's end-of-round fold) stay INSIDE the
+        loop, so every carry the decision reads is replicated and only
+        the window-edge fold reaches the host — K reference barriers
+        collapse into one surfacing."""
+        i32 = jnp.int32
+        nbits = self.nc + self.nl
+        width = sp.shape[1]
+        below_cut, hyst, budget = fa["below_cut"], fa["hyst"], fa["budget"]
+        mk = fa["mk"]
+        sparse_on = bool(caps[0])
+        gating = self._gate is not None
+
+        def cond(carry):
+            _sp, _rp, _g, _dl, _sc, _b, it_, rdone, status = carry[:9]
+            return (status == 0) & (rdone < K) & (it_ < budget)
+
+        def body(carry):
+            (sp, rp, gate, dl, sc, below, it_, rdone, status,
+             tb, rb, db, cb, bb) = carry
+            plan = self._fused_round_plan_dev(sc, dl, fa)
+            rows = plan["rows"]
+            below_next = jnp.where(
+                rows <= below_cut, below + 1, jnp.asarray(0, i32)
+            )
+            idle = rows == 0
+            if sparse_on:
+                want_sparse = (it_ > 0) & (below_next >= hyst)
+                fits = (
+                    jnp.maximum(
+                        jnp.maximum(plan["n1"], plan["n2"]), plan["n3"]
+                    )
+                    <= caps[0]
+                )
+                if self._scan4 is not None:
+                    fits = fits & (plan["n4"] <= caps[1])
+                if self._scan6 is not None:
+                    fits = fits & (plan["n6"] <= caps[2])
+                use_sparse = want_sparse & fits & ~idle
+                fallout = want_sparse & ~fits & ~idle
+            else:
+                use_sparse = fallout = jnp.asarray(False)
+
+            ops = (sp, rp, gate, dl, sc)
+
+            def run_dense(ops):
+                sp, rp, gate, dl, sc = ops
+                ch = jnp.asarray(False)
+                dirty = (gate, dl, sc)
+                for _ in range(self.unroll):
+                    sp, rp, c, dirty = self._step(
+                        sp, rp, mk, axis_name, dirty
+                    )
+                    ch |= c
+                if axis_name is not None:
+                    ch = lax.psum(ch.astype(i32), axis_name) > 0
+                bits = self._live_bits(
+                    sp, rp, axis_name,
+                    wmask=mk["wmask"] if self._bucket else None,
+                )
+                gate, dl, sc = dirty
+                return (
+                    sp, rp, gate, dl, sc, ch, jnp.asarray(0, i32), bits
+                )
+
+            def run_noop(ops):
+                sp, rp, gate, dl, sc = ops
+                return (
+                    sp, rp, gate, dl, sc, jnp.asarray(False),
+                    jnp.asarray(0, i32), jnp.zeros(nbits, i32),
+                )
+
+            branches = [run_dense, run_noop]
+            if sparse_on:
+
+                def run_sparse(ops):
+                    sp, rp, gate, dl, sc = ops
+                    sa = self._fused_sparse_args_dev(plan, dl, fa, caps)
+                    sp, rp, ch, delta, mask_s, any_r, dl2 = (
+                        self._sparse_exec(sp, rp, sa, axis_name)
+                    )
+                    if gating:
+                        gate = self._next_dirty(
+                            mask_s, any_r, axis_name, mk
+                        )
+                    return (
+                        sp, rp, gate, dl2, mask_s, ch, delta,
+                        jnp.zeros(nbits, i32),
+                    )
+
+                branches = [run_dense, run_sparse, run_noop]
+                bix = jnp.where(
+                    idle | fallout, 2, jnp.where(use_sparse, 1, 0)
+                )
+            else:
+                bix = jnp.where(idle, 1, 0).astype(i32)
+
+            sp, rp, gate, dl, sc, ch, delta, bits = lax.switch(
+                bix, branches, ops
+            )
+            noop_ix = len(branches) - 1
+            tier = jnp.where(
+                idle, 2, jnp.where(bix == noop_ix, 0, bix)
+            ).astype(i32)
+            keep = fallout  # the round did not run: record nothing
+
+            def upd(buf, new):
+                old_row = buf[rdone]
+                return buf.at[rdone].set(jnp.where(keep, old_row, new))
+
+            tb = upd(tb, tier)
+            rb = upd(rb, rows)
+            db = upd(db, delta)
+            cb = upd(cb, ch)
+            bb = upd(bb, bits)
+            step_it = jnp.where(idle | use_sparse, 1, self.unroll)
+            it_next = jnp.where(fallout, it_, it_ + step_it)
+            rdone_next = jnp.where(fallout, rdone, rdone + 1)
+            status_next = jnp.where(
+                fallout, 2, jnp.where(ch, 0, 1)
+            ).astype(i32)
+            below_out = jnp.where(fallout, below, below_next)
+            return (
+                sp, rp, gate, dl, sc, below_out, it_next, rdone_next,
+                status_next, tb, rb, db, cb, bb,
+            )
+
+        init = (
+            sp, rp, gate, dl, sc, below.astype(i32), it.astype(i32),
+            jnp.asarray(0, i32), jnp.asarray(0, i32),
+            jnp.full(K, -1, i32), jnp.zeros(K, i32), jnp.zeros(K, i32),
+            jnp.zeros(K, bool), jnp.zeros((K, nbits), i32),
+        )
+        return lax.while_loop(cond, body, init)
+
+    def _fused_sig(self, fa_av) -> str:
+        """Aval signature of the fused argument pytree — the fused
+        analog of the dense program's aval hash inside
+        ``bucket_signature``.  The rule-table lengths the plan tables
+        carry are NOT bucket-quantized, so two same-bucket engines may
+        trace different fused programs; the registry key carries this
+        hash alongside the bucket signature to keep sharing sound."""
+        parts = jax.tree_util.tree_map(
+            lambda a: (tuple(a.shape), str(a.dtype)), fa_av
+        )
+        leaves, treedef = jax.tree_util.tree_flatten(parts)
+        return signature_of((tuple(leaves), str(treedef)), "fa")
+
+    def _fused_aot(self, K: int, caps: Tuple[int, int, int], fa: dict):
+        """Compiled fused-window executable for one (K, workspace
+        capacities) pair — same registry/caching story as
+        :meth:`_sparse_aot`: bucket-mode engines share executables
+        through PROGRAMS (K, the capacity triple and the fused argument
+        avals ride in the key), and the XLA compile of byte-identical
+        HLO is normally a persistent-cache hit."""
+        key = (int(K),) + tuple(int(x) for x in caps)
+        exe = self._aot_fused.get(key)
+        if exe is not None:
+            return exe
+        stats = CompileStats(
+            bucket_signature=self.bucket_signature,
+            program=f"fused[K={K};{caps[0]},{caps[1]},{caps[2]}]",
+        )
+        u32 = jnp.uint32
+        aval = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+            np.shape(x), jnp.asarray(x).dtype
+        )
+        fa_av = jax.tree_util.tree_map(aval, fa)
+        sp_av = jax.ShapeDtypeStruct((self.nc, self.wc), u32)
+        rp_av = jax.ShapeDtypeStruct((self.nl, self.wc), u32)
+        n_flags = self._gate["n_flags"] if self._gate else 0
+        carry_av = (
+            jax.ShapeDtypeStruct((max(n_flags, 1),), jnp.bool_),
+            jax.ShapeDtypeStruct((self.n_lchunks,), jnp.bool_),
+            jax.ShapeDtypeStruct((self.nc,), jnp.bool_),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+        if self.mesh is None:
+            fn = jax.jit(
+                functools.partial(self._fused_exec, K=K, caps=caps),
+                donate_argnums=_state_donation(),
+            )
+        else:
+            # same shard_map structure as the per-round programs: state
+            # on the packed word axis, plan tables + carries replicated,
+            # every output replicated by the in-loop psum folds except
+            # the per-shard live-bit partials
+            P = jax.sharding.PartitionSpec
+            axis = self.word_axis
+            state = P(None, axis)
+            fn = jax.jit(
+                shard_map(
+                    functools.partial(
+                        self._fused_exec, K=K, caps=caps, axis_name=axis
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(
+                        state, state, P(), P(), P(), P(), P(),
+                        jax.tree.map(lambda _: P(), fa_av),
+                    ),
+                    out_specs=(
+                        (state, state) + (P(),) * 11 + (P(None, axis),)
+                    ),
+                    check_vma=False,
+                ),
+                donate_argnums=_state_donation(),
+            )
+
+        def build():
+            t0 = time.perf_counter()
+            lowered = fn.lower(sp_av, rp_av, *carry_av, fa_av)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            stats.trace_lower_s = t1 - t0
+            stats.compile_s = time.perf_counter() - t1
+            return compiled
+
+        with compile_watch(stats):
+            if self._bucket:
+                exe, hit = PROGRAMS.get_or_build(
+                    (
+                        self.bucket_signature, "fused", key,
+                        self._fused_sig(fa_av),
+                    ),
+                    build,
+                )
+                stats.program_cache_hit = hit
+            else:
+                exe = build()
+        self._aot_fused[key] = exe
+        self._fused_builds.append(stats)
+        self._note_compile(stats)
+        return exe
+
     # ------------------------------------------- programs & compilation
 
     def _compute_signature(self) -> str:
@@ -2844,7 +3387,7 @@ class RowPackedSaturationEngine:
         self,
         max_iters: int = 10_000,
         *,
-        programs: Tuple[str, ...] = ("run", "step", "sparse"),
+        programs: Tuple[str, ...] = ("run", "step", "sparse", "fused"),
         parallel: Optional[bool] = None,
         max_workers: Optional[int] = None,
     ) -> CompileStats:
@@ -2894,6 +3437,22 @@ class RowPackedSaturationEngine:
                         self._sparse_aot(*mixed)
 
                 roster["sparse"] = sparse_floor
+            if self._fused_eligible():
+
+                def fused_floor():
+                    scfg = self._sparse_cfg
+                    floor = scfg["capacity_floor"]
+                    self._fused_aot(
+                        self._fused_cfg["rounds"],
+                        (
+                            floor,
+                            floor if self._scan4 else 0,
+                            floor if self._scan6 else 0,
+                        ),
+                        self._fused_run_args(scfg, budget),
+                    )
+
+                roster["fused"] = fused_floor
             tasks = [roster[name] for name in programs if name in roster]
         else:
 
@@ -2915,6 +3474,22 @@ class RowPackedSaturationEngine:
                 self._note_compile(stats)
 
             tasks = [mesh_run]
+            if "fused" in programs and self._fused_eligible():
+
+                def mesh_fused():
+                    scfg = self._sparse_cfg
+                    floor = scfg["capacity_floor"]
+                    self._fused_aot(
+                        self._fused_cfg["rounds"],
+                        (
+                            floor,
+                            floor if self._scan4 else 0,
+                            floor if self._scan6 else 0,
+                        ),
+                        self._fused_run_args(scfg, budget),
+                    )
+
+                tasks.append(mesh_fused)
         if parallel is None:
             parallel = len(tasks) > 1
         if parallel and len(tasks) > 1:
@@ -3177,6 +3752,10 @@ class RowPackedSaturationEngine:
         self._m6_full = m6_new.astype(bool)
         self._m4_any = (self._m4_full & self._max_dirty_roles).any(axis=1)
         self._m6_any = (self._m6_full & self._max_dirty_roles).any(axis=1)
+        # the fused tier's device plan tables mirror these host arrays
+        # (factored masks, live-window validity, slab leaves) — rebuild
+        # them lazily under the grown closure
+        self._fused_tab_cache = None
         self.idx = dataclasses.replace(idx, role_closure=h_new)
         return True
 
@@ -4201,6 +4780,7 @@ class RowPackedSaturationEngine:
                 latest = pool.submit(_run)
                 ent = {"fut": latest}
             dispatched += self.unroll
+            DISPATCH_EVENTS.record_dense()
             ent.update({
                 "iteration": dispatched,
                 "dispatch_s": time.perf_counter() - t0,
@@ -4325,6 +4905,7 @@ class RowPackedSaturationEngine:
                 elif use_sparse:
                     plan = self._sparse_round_args(measure, dirty_l)
                     exe = self._sparse_aot(*plan["key"])
+                    DISPATCH_EVENTS.record_sparse()
                     sp, rp, ch_d, delta_d, ms_d, ar_d, dl_d = exe(
                         sp, rp, self._sparse_args(plan)
                     )
@@ -4373,6 +4954,351 @@ class RowPackedSaturationEngine:
             sp, rp = latest.result()[:2]
         return sp, rp, iteration, total, converged
 
+    _FUSED_TIERS = {0: "dense", 1: "sparse", 2: "idle"}
+
+    def _saturate_fused(
+        self, cfg, K, sp, rp, init_total, budget, observer,
+        frontier_observer, pipeline_depth: int = 1,
+    ):
+        """The K-round fused-window controller (ISSUE 17): each
+        dispatch runs :meth:`_fused_exec` — up to K rounds of the
+        adaptive controller inside one device program — and the host
+        work that :meth:`_saturate_adaptive` pays per round (dispatch,
+        frontier fetch, fold, observer callbacks) is paid per WINDOW.
+        Per-round telemetry is reconstructed at retire from the
+        window's on-device round buffers, so observers still see every
+        retired round, each stamped ``rounds_in_window = rounds the
+        window retired`` with the window walls divided evenly across
+        them (the s/round fit must never mistake a window wall for a
+        round wall).
+
+        The retired round sequence is byte-identical to the
+        synchronous adaptive controller: the device replays its exact
+        per-round decision, and the two escapes both hand control back
+        without ever running a round differently —
+
+        * capacity fallout (status 2): a round's sparse frontier
+          overflowed the workspace rung traced into the window program.
+          The round DID NOT RUN; the host replays that one round on the
+          per-round path (which can still pick a bigger sparse rung, or
+          the dense step with the overflow flag — exactly the
+          synchronous decision) and resumes windows.
+        * convergence (status 1): the window's last retired round
+          derived nothing; any speculative windows behind it retire
+          only fixed-point idle rounds and are dropped unretired, like
+          the adaptive controller's speculative dense rounds.
+
+        Pipelining speculates whole WINDOWS (depth windows in flight,
+        chained on the previous window's device carries).  Unlike the
+        adaptive controller, speculation never goes stale: the tier
+        decision rides inside the device program, so a speculative
+        window is wrong only about its workspace capacities — and that
+        surfaces as a deterministic fallout, never a divergent round."""
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._ensure_observe_jit()
+        depth = max(int(pipeline_depth), 1)
+        unroll = self.unroll
+        n_flags = self._gate["n_flags"] if self._gate else 0
+        gate_flags = np.ones(max(n_flags, 1), bool)
+        s_chg = np.ones(self.nc, bool)
+        dirty_l = np.ones(self.n_lchunks, bool)
+        any_r = True
+        below = 0
+        iteration, total, converged = 0, init_total, False
+        fa = self._fused_run_args(cfg, budget)
+        floor = cfg["capacity_floor"]
+        i32 = jnp.int32
+        pending = deque()  # in-flight fused windows, oldest first
+        pool = (
+            ThreadPoolExecutor(1, thread_name_prefix="fused-pipeline")
+            if depth > 1
+            else None
+        )
+        latest = None  # newest dispatched window's future (pool mode)
+        self.frontier_rounds = []
+
+        def finish_round(st, changed):
+            nonlocal converged
+            FRONTIER_EVENTS.record(st)
+            self.frontier_rounds.append(st)
+            if frontier_observer is not None:
+                frontier_observer(st)
+            if observer is not None:
+                observer(st.iteration, total - init_total, changed)
+            if not changed:
+                converged = True
+
+        def pick_caps():
+            """Workspace capacities for the next window, measured from
+            the host frontier at this sync point.  Later rounds in the
+            window may outgrow them — that is the fallout path, never
+            an error — so CR4/CR6 get at least the floor rung even
+            when currently inactive (the host per-round key would use
+            0 and trace the block away; the window program keeps it so
+            mid-window activations don't fall out needlessly)."""
+            _rows, _den, measure, _over = self._sparse_round_plan(
+                cfg, s_chg, dirty_l, any_r
+            )
+            if measure is None:
+                key = (floor, floor, floor)
+            else:
+                key = measure["key"]
+            return (
+                key[0],
+                max(key[1], floor) if self._scan4 is not None else 0,
+                max(key[2], floor) if self._scan6 is not None else 0,
+            )
+
+        def host_carry():
+            return (
+                jnp.asarray(gate_flags),
+                jnp.asarray(dirty_l),
+                jnp.asarray(s_chg),
+                jnp.asarray(below, i32),
+                jnp.asarray(iteration, i32),
+            )
+
+        def dispatch_window(caps):
+            nonlocal sp, rp, latest
+            exe = self._fused_aot(K, caps, fa)
+            t0 = time.perf_counter()
+            if pool is None:
+                out = exe(sp, rp, *host_carry(), fa)
+                sp, rp = out[0], out[1]
+                ent = {"out": out}
+            else:
+
+                def _run(prev=latest, s0=sp, r0=rp, c0=host_carry()):
+                    if prev is None:
+                        a, b, c = s0, r0, c0
+                    else:
+                        # chain on the previous window's DEVICE carries
+                        a, b, c = prev.result()[:3]
+                    out = exe(a, b, *c, fa)
+                    return (
+                        out[0], out[1], out[2:7], fetch_global(out[2:])
+                    )
+
+                latest = pool.submit(_run)
+                ent = {"fut": latest}
+            ent.update({
+                "dispatch_s": time.perf_counter() - t0,
+                "inflight": len(pending),
+            })
+            pending.append(ent)
+
+        def retire_window():
+            """Retire the oldest in-flight window: fetch its exit
+            carries + round buffers, reconstruct the retired rounds'
+            FrontierStats, and fold the exit frontier into the host
+            copies.  Returns the window's exit status."""
+            nonlocal total, below, iteration
+            nonlocal gate_flags, dirty_l, s_chg, any_r
+            ent = pending.popleft()
+            t1 = time.perf_counter()
+            if pool is None:
+                fetched = fetch_global(ent["out"][2:])
+            else:
+                fetched = ent["fut"].result()[3]
+            retire_s = time.perf_counter() - t1
+            (gf, dl_, ms_, below_o, it_o, rdone_o, status_o,
+             tb, rb, db, cb, bb) = fetched
+            rdone, status = int(rdone_o), int(status_o)
+            if rdone:
+                DISPATCH_EVENTS.record_fused_window(rdone)
+                it_r = iteration
+                run_total = total
+                for r in range(rdone):
+                    tier = int(tb[r])
+                    rows = int(rb[r])
+                    changed = bool(cb[r])
+                    if tier == 0:  # dense
+                        it_r += unroll
+                        new_total = _host_bit_total(bb[r])
+                        delta = new_total - run_total
+                        run_total = new_total
+                    elif tier == 1:  # sparse
+                        it_r += 1
+                        delta = int(db[r])
+                        run_total += delta
+                    else:  # idle
+                        it_r += 1
+                        delta = 0
+                    total = run_total
+                    finish_round(
+                        FrontierStats(
+                            iteration=it_r,
+                            tier=self._FUSED_TIERS[tier],
+                            density=rows / max(self._sp_total_rows, 1),
+                            rows_touched=rows,
+                            total_rows=self._sp_total_rows,
+                            derivations=delta,
+                            overflow=False,
+                            wall_s=(ent["dispatch_s"] + retire_s) / rdone,
+                            dispatch_s=ent["dispatch_s"] / rdone,
+                            retire_s=retire_s / rdone,
+                            inflight=ent["inflight"],
+                            rounds_in_window=rdone,
+                        ),
+                        changed,
+                    )
+            gate_flags = np.asarray(gf)
+            dirty_l = np.asarray(dl_)
+            s_chg = np.asarray(ms_)
+            any_r = bool(dirty_l.any())
+            below = int(below_o)
+            iteration = int(it_o)
+            return status, rdone
+
+        def drain_to_host():
+            """Drop any still-speculative windows and re-anchor the
+            main-thread device state on the newest window's outputs —
+            byte-identical to the oldest retired exit: windows behind
+            a fallout replay the same decision and exit immediately,
+            windows behind convergence retire only idle rounds, both
+            pure passthrough on the state."""
+            nonlocal sp, rp, latest
+            pending.clear()
+            if latest is not None:
+                sp, rp = latest.result()[:2]
+                latest = None
+
+        def replay_host_round():
+            """One round of the SYNCHRONOUS adaptive controller on the
+            host frontier — the fallout escape.  Replays the full
+            decision (the true capacity ladder may still fit a bigger
+            sparse rung than the window had traced; otherwise this is
+            the dense round the per-round controller would run, with
+            its overflow flag)."""
+            nonlocal sp, rp, total, below, iteration
+            nonlocal gate_flags, dirty_l, s_chg, any_r
+            t0 = time.perf_counter()
+            prev_total = total
+            rows_touched, density, measure, over = self._sparse_round_plan(
+                cfg, s_chg, dirty_l, any_r
+            )
+            if density < cfg["density_threshold"]:
+                below += 1
+            else:
+                below = 0
+            want_sparse = (
+                iteration > 0 and below >= cfg["hysteresis_rounds"]
+            )
+            use_sparse = want_sparse and measure is not None
+            if rows_touched == 0:
+                iteration += 1
+                finish_round(
+                    FrontierStats(
+                        iteration=iteration,
+                        tier="idle",
+                        density=float(density),
+                        rows_touched=rows_touched,
+                        total_rows=self._sp_total_rows,
+                        derivations=0,
+                        overflow=False,
+                        wall_s=time.perf_counter() - t0,
+                    ),
+                    False,
+                )
+            elif use_sparse:
+                plan = self._sparse_round_args(measure, dirty_l)
+                exe = self._sparse_aot(*plan["key"])
+                DISPATCH_EVENTS.record_sparse()
+                sp, rp, ch_d, delta_d, ms_d, ar_d, dl_d = exe(
+                    sp, rp, self._sparse_args(plan)
+                )
+                ch, delta, s_chg, ar, dirty_l = fetch_global(
+                    (ch_d, delta_d, ms_d, ar_d, dl_d)
+                )
+                any_r = bool(ar)
+                total += int(delta)
+                gate_flags = self._host_gate_flags(s_chg, any_r)
+                iteration += 1
+                finish_round(
+                    FrontierStats(
+                        iteration=iteration,
+                        tier="sparse",
+                        density=float(density),
+                        rows_touched=rows_touched,
+                        total_rows=self._sp_total_rows,
+                        derivations=total - prev_total,
+                        overflow=False,
+                        wall_s=time.perf_counter() - t0,
+                    ),
+                    bool(ch),
+                )
+            else:
+                dirty_dev = (
+                    jnp.asarray(gate_flags),
+                    jnp.asarray(dirty_l),
+                    jnp.asarray(s_chg),
+                )
+                sp, rp, ch_d, bits_d, dirty_d = self._observe_jit(
+                    sp, rp, dirty_dev, self._masks
+                )
+                DISPATCH_EVENTS.record_dense()
+                ch, bits, (gf, dl_, ms_) = fetch_global(
+                    (ch_d, bits_d, dirty_d)
+                )
+                total = _host_bit_total(bits)
+                gate_flags = np.asarray(gf)
+                dirty_l = np.asarray(dl_)
+                s_chg = np.asarray(ms_)
+                any_r = bool(dirty_l.any())
+                iteration += unroll
+                finish_round(
+                    FrontierStats(
+                        iteration=iteration,
+                        tier="dense",
+                        density=float(density),
+                        rows_touched=rows_touched,
+                        total_rows=self._sp_total_rows,
+                        derivations=total - prev_total,
+                        overflow=bool(
+                            want_sparse and measure is None and over
+                        ),
+                        wall_s=time.perf_counter() - t0,
+                    ),
+                    bool(ch),
+                )
+
+        try:
+            while True:
+                if converged:
+                    break  # drop still-speculative windows (idle no-ops)
+                if pending:
+                    if len(pending) < depth:
+                        # speculative window: same capacities as the
+                        # last sync measure (wrong guesses surface as
+                        # deterministic fallout, never a changed round)
+                        dispatch_window(cur_caps)
+                    else:
+                        status, rdone = retire_window()
+                        if status == 2:
+                            drain_to_host()
+                            replay_host_round()
+                        elif status == 0 and rdone == 0:
+                            # budget exhausted device-side: the window
+                            # entered with iteration >= budget
+                            drain_to_host()
+                            break
+                    continue
+                if iteration >= budget:
+                    break
+                # ---- pipeline drained: the synchronous sync point ----
+                cur_caps = pick_caps()
+                dispatch_window(cur_caps)
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
+        if latest is not None:
+            # pool mode: the current state is the newest window's
+            # outputs (dropped speculative windows are passthrough)
+            sp, rp = latest.result()[:2]
+        return sp, rp, iteration, total, converged
+
     def saturate_observed(
         self,
         max_iters: int = 10_000,
@@ -4384,6 +5310,7 @@ class RowPackedSaturationEngine:
         sparse_tail=None,
         frontier_observer=None,
         pipeline=None,
+        fused_rounds=None,
     ) -> SaturationResult:
         """Fixed point with per-superstep observation — the observable
         analog of the reference's progress plane (pub-sub gossip consumed
@@ -4413,7 +5340,21 @@ class RowPackedSaturationEngine:
         ``pipeline``: per-call override of the engine's pipelined-
         observation config (``{"enable": ..., "depth": ...}``).  A
         ``state_observer`` forces the synchronous depth-1 loop — its
-        contract hands over live, not-yet-donated round state."""
+        contract hands over live, not-yet-donated round state.
+
+        ``fused_rounds``: per-call override of the engine's
+        device-resident fused-rounds config (``{"enable": ...,
+        "rounds": K}``).  With K > 1 the round loop itself moves onto
+        the device — up to K rounds of the adaptive controller per
+        dispatch (see :meth:`_saturate_fused`) — surfacing to the host
+        only at window edges; the retired round sequence stays
+        byte-identical to the per-round controllers.  K = 1 routes the
+        unchanged per-round path.  The fused tier needs the sparse
+        tail's frontier machinery for its on-device round decision, so
+        it engages only when the adaptive controller would (dense-only
+        fused runs: set ``density_threshold: 0.0`` so the density test
+        never picks sparse); a ``state_observer`` needs live per-round
+        state and forces the per-round path."""
         self._ensure_observe_jit()
         if initial is None:
             sp, rp = self.initial_state()
@@ -4436,7 +5377,23 @@ class RowPackedSaturationEngine:
             else self._normalize_pipeline_cfg(pipeline)
         )
         pdepth = pcfg["depth"] if pcfg["enable"] else 1
-        if cfg is not None and self._sparse_supported():
+        kcfg = (
+            self._fused_cfg
+            if fused_rounds is None
+            else self._normalize_fused_cfg(fused_rounds)
+        )
+        fk = int(kcfg["rounds"]) if kcfg else 1
+        if (
+            fk > 1
+            and cfg is not None
+            and self._sparse_supported()
+            and state_observer is None
+        ):
+            sp, rp, iteration, total, converged = self._saturate_fused(
+                cfg, fk, sp, rp, init_total, budget, observer,
+                frontier_observer, pipeline_depth=pdepth,
+            )
+        elif cfg is not None and self._sparse_supported():
             sp, rp, iteration, total, converged = self._saturate_adaptive(
                 cfg, sp, rp, init_total, budget, observer,
                 state_observer, frontier_observer,

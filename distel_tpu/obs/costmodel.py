@@ -216,7 +216,12 @@ def load_ledger_observations(path: str) -> List[ProbeObs]:
                 n=int(n),
                 kind="exec",
                 source=f"{os.path.basename(path)}#{chain_id}",
-                rounds=len(rounds_),
+                # a fused-window record covers rounds_in_window retired
+                # rounds (ISSUE 17) — count rounds, not records, or the
+                # s/round signal inflates K×
+                rounds=sum(
+                    int(r.get("rounds_in_window") or 1) for r in rounds_
+                ),
                 wall_s=wall,
                 # max, not last-in-file: a crashed tail can outrank the
                 # resumed session's newest record

@@ -319,7 +319,12 @@ def _validate_chain(records: List[dict]) -> Tuple[dict, List[dict]]:
         "runs": sum(1 for r in records if r.get("ev") == "open"),
         "closed_runs": closed_runs,
         "crashed_runs": crashed_runs,
-        "rounds": len(effective),
+        # one fused-window record covers rounds_in_window retired
+        # rounds (ISSUE 17): count retired rounds, not records
+        "rounds": sum(
+            int(r.get("rounds_in_window") or 1) for r in effective
+        ),
+        "records": len(effective),
         "last_round": effective[-1]["round"] if effective else -1,
         "snapshots": snapshots,
         "anomalies": anomalies,
@@ -745,6 +750,8 @@ class LedgerObserver:
         self._t0 = time.perf_counter()
         self._last_t = self._t0
         self._prev_derivs = 0
+        self._win_rounds = 0  # fused-window accumulation (ISSUE 17)
+        self._win_delta = 0
         self._rule_captures = -1
         self._rule_seconds: Optional[dict] = None
         self._st = None  # FrontierStats stash (rowpacked engines only)
@@ -784,18 +791,44 @@ class LedgerObserver:
 
     def observer(self, iteration: int, derivations: int, changed: bool):
         now = time.perf_counter()
-        round_wall = now - self._last_t
-        self._last_t = now
-        elapsed = now - self._t0
-        self.last_elapsed_s = elapsed
+        st = self._st
+        riw = (
+            int(getattr(st, "rounds_in_window", 1) or 1)
+            if st is not None and st.iteration == iteration
+            else 1
+        )
         self.rounds += 1
         delta = int(derivations) - self._prev_derivs
         self._prev_derivs = int(derivations)
         self.last_iteration = int(iteration)
         self.last_derivations = int(derivations)
+        if riw > 1:
+            # fused window (ISSUE 17): this round surfaced together
+            # with its window-mates — ONE ledger record per surfaced
+            # window, written at the window's last round, carrying the
+            # whole window wall plus ``rounds_in_window`` so readers
+            # divide instead of fitting window walls as round walls.
+            # The ETA still sees every retired round: the device-
+            # honest per-round wall rides in the FrontierStats.
+            eta_s, remaining = self._eta.update(
+                float(getattr(st, "wall_s", 0.0) or 0.0), delta
+            )
+            self.last_eta_s = eta_s
+            self._win_rounds += 1
+            self._win_delta += delta
+            if self._win_rounds < riw:
+                return
+            delta = self._win_delta
+        self._win_rounds = 0
+        self._win_delta = 0
+        round_wall = now - self._last_t
+        self._last_t = now
+        elapsed = now - self._t0
+        self.last_elapsed_s = elapsed
         round_total = self.base_iters + int(iteration)
-        eta_s, remaining = self._eta.update(round_wall, delta)
-        self.last_eta_s = eta_s
+        if riw == 1:
+            eta_s, remaining = self._eta.update(round_wall, delta)
+            self.last_eta_s = eta_s
         host_mb = host_peak_mb()
         fields = {
             "round": round_total,
@@ -804,9 +837,9 @@ class LedgerObserver:
             "derivations_total": self.base_derivs + int(derivations),
             "changed": bool(changed),
             "round_wall_s": round(round_wall, 4),
+            "rounds_in_window": riw,
             "elapsed_s": round(elapsed, 3),
         }
-        st = self._st
         if st is not None and st.iteration == iteration:
             fields.update(
                 tier=st.tier,

@@ -124,6 +124,13 @@ def make_engine(
         # without a blocking host sync per superstep) — the serving
         # paths run the observed loop, so this is their throughput knob
         rowpacked_kw.setdefault("pipeline", config.pipeline_config())
+        # device-resident fused rounds: with fused.rounds.k > 1 the
+        # observed fixed point runs K rounds per dispatch (tier pick +
+        # convergence on device) — REBUILD classifies and retract
+        # repairs inherit the window size from config through here
+        rowpacked_kw.setdefault(
+            "fused_rounds", config.fused_rounds_config()
+        )
         # live-tile CR6 (core/cr6_tiles.py): structure-packed
         # role-chain join, byte-identical per round, engaged only when
         # the live structure is sparse enough to pay

@@ -183,6 +183,13 @@ class FrontierStats:
     dispatch_s: float = 0.0
     retire_s: float = 0.0
     inflight: int = 0
+    #: how many retired rounds the surfacing that produced this stat
+    #: covered — 1 for the per-round controllers, K (well, rounds
+    #: actually retired, ≤ K) for every round of a fused device-resident
+    #: window (ISSUE 17).  Ledger/costmodel consumers divide window wall
+    #: by this so the s/round fit never mistakes a window wall for a
+    #: round wall.
+    rounds_in_window: int = 1
 
     def as_dict(self) -> dict:
         return {
@@ -197,6 +204,7 @@ class FrontierStats:
             "dispatch_s": round(self.dispatch_s, 4),
             "retire_s": round(self.retire_s, 4),
             "inflight": self.inflight,
+            "rounds_in_window": self.rounds_in_window,
         }
 
 
@@ -371,6 +379,66 @@ class CohortAggregate:
 
 
 COHORT_EVENTS = CohortAggregate()
+
+
+class RoundDispatchAggregate:
+    """Process-global tally of saturation ROUND DISPATCHES — the
+    counted evidence the fused device-resident fixed point's acceptance
+    rests on (ISSUE 17): "dispatch count collapses ≥ K×" must come from
+    counters incremented at the actual ``jit``-call sites, never
+    inferred from wall clocks.  ``record_dense`` fires once per dense
+    multi-step device launch (the observed loop's and the adaptive
+    controller's per-round dispatches), ``record_sparse`` once per
+    sparse-tail launch, and ``record_fused_window`` once per fused
+    K-round window launch, carrying how many rounds the one dispatch
+    retired.  Tests, the tier-1 smoke, and ``bench.py`` snapshot
+    before/after deltas: per-round paths pay ``rounds`` dispatches
+    where the fused path pays ``ceil(rounds / K)``.  Thread-safe:
+    scheduler workers and speculative pipeline workers dispatch
+    concurrently."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        #: per-round dense step dispatches (one device launch each)
+        self.dense_dispatches = 0
+        #: per-round sparse-tail dispatches (one device launch each)
+        self.sparse_dispatches = 0
+        #: fused multi-round window dispatches (one device launch each)
+        self.fused_windows = 0
+        #: rounds retired summed over fused windows (÷ windows = the
+        #: measured amortization per device launch)
+        self.fused_rounds_retired = 0
+        #: rounds actually retired in the most recent fused window
+        self.last_window_rounds = 0
+
+    def record_dense(self, n: int = 1) -> None:
+        with self._lock:
+            self.dense_dispatches += n
+
+    def record_sparse(self) -> None:
+        with self._lock:
+            self.sparse_dispatches += 1
+
+    def record_fused_window(self, rounds_retired: int) -> None:
+        with self._lock:
+            self.fused_windows += 1
+            self.fused_rounds_retired += int(rounds_retired)
+            self.last_window_rounds = int(rounds_retired)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dense_dispatches": self.dense_dispatches,
+                "sparse_dispatches": self.sparse_dispatches,
+                "fused_windows": self.fused_windows,
+                "fused_rounds_retired": self.fused_rounds_retired,
+                "last_window_rounds": self.last_window_rounds,
+            }
+
+
+DISPATCH_EVENTS = RoundDispatchAggregate()
 
 
 class _PersistentCacheCounter:

@@ -104,6 +104,7 @@ def warmup_text(
         "links": idx.n_links,
         "wall_s": round(time.monotonic() - t0, 3),
         "sparse_programs": len(getattr(engine, "_sparse_builds", ())),
+        "fused_programs": len(getattr(engine, "_fused_builds", ())),
         "delta_programs": len(delta_recs),
         "delta_compile_s": round(
             sum(r["compile_s"] + r["trace_lower_s"] for r in delta_recs),

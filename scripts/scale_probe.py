@@ -114,6 +114,21 @@ def _parse_args(argv=None):
                          "carry the dispatch/retire host-time split and "
                          "the queue occupancy (inflight) so the overlap "
                          "actually won is visible per round")
+    ap.add_argument("--surface-every", type=int, default=None,
+                    metavar="K",
+                    help="device-resident fused rounds for observed "
+                         "--execute runs: retire K saturation rounds "
+                         "per dispatch (tier pick + convergence test "
+                         "on device), surfacing to the host only at "
+                         "window edges.  Per-round progress lines "
+                         "still appear — reconstructed at retire from "
+                         "the window's on-device buffers — and carry "
+                         "rounds_in_window so the collapse is visible "
+                         "per line.  1 = the per-round controller "
+                         "(default).  NOTE --snapshot-every keeps the "
+                         "per-round host path (the state observer "
+                         "needs every round's state), so K > 1 is "
+                         "ignored while snapshotting is armed")
     ap.add_argument("--run-id", default=None,
                     help="session identity stamped into every per-round "
                          "progress line and mid-run snapshot, so chains "
@@ -153,6 +168,8 @@ def _parse_args(argv=None):
         # launch-time guard: all resume handling lives on the execute
         # path, and a silently ignored --resume-from costs hours
         ap.error("--resume-from requires --execute")
+    if args.surface_every is not None and args.surface_every < 1:
+        ap.error("--surface-every must be >= 1")
     return args
 
 
@@ -333,6 +350,12 @@ def run_probe(args) -> None:
     # same shard_map structure as the dense step, and the pipelined
     # controller drives both paths identically
     want_sparse = bool(args.sparse_tail and will_observe)
+    # device-resident fused rounds (ISSUE 17): K rounds per dispatch on
+    # observed runs.  Snapshotting keeps the per-round path — the state
+    # observer needs every round's state on the host — so an armed
+    # --snapshot-every silently wins over --surface-every (announced in
+    # the record as surface_every_effective)
+    surface_k = int(args.surface_every or 1)
     engine = RowPackedSaturationEngine(
         idx, mesh=mesh,
         sparse_tail=(True if want_sparse else None),
@@ -340,6 +363,10 @@ def run_probe(args) -> None:
         pipeline=(
             None if args.pipeline_depth is None
             else {"depth": args.pipeline_depth}
+        ),
+        fused_rounds=(
+            {"enable": True, "rounds": surface_k}
+            if surface_k > 1 else None
         ),
     )
     rec["build_s"] = round(time.time() - t0, 1)
@@ -350,6 +377,17 @@ def run_probe(args) -> None:
         want_sparse and engine._sparse_supported()
     )
     rec["pipeline"] = dict(engine._pipeline_cfg)
+    # the asked-for window size and what the run will actually do:
+    # snapshotting (state observer) and an ineligible engine (no sparse
+    # tier / unsupported layout) both degrade to the per-round loop
+    rec["surface_every"] = surface_k
+    rec["surface_every_effective"] = (
+        surface_k
+        if surface_k > 1
+        and engine._fused_eligible()
+        and not (args.execute and want_snap)
+        else 1
+    )
     # resolved program identity + (later) the compile-vs-execute wall
     # split: announced at LAUNCH so a killed multi-hour run still
     # records which bucket/program it was paying for
@@ -471,7 +509,8 @@ def run_probe(args) -> None:
                     for k in (
                         "n_classes", "shape", "devices", "n_shards",
                         "backend", "n_concepts", "n_links",
-                        "bucket_signature",
+                        "bucket_signature", "surface_every",
+                        "surface_every_effective",
                     )
                     if k in rec
                 },
@@ -555,6 +594,15 @@ def run_probe(args) -> None:
                         line["dispatch_s"] = round(st.dispatch_s, 4)
                         line["retire_s"] = round(st.retire_s, 4)
                         line["inflight"] = st.inflight
+                        # fused windows (--surface-every K): every
+                        # round of a window surfaces at the same
+                        # retire, so the lines carry the window size —
+                        # K lines per host sync instead of one
+                        riw = int(
+                            getattr(st, "rounds_in_window", 1) or 1
+                        )
+                        if riw > 1:
+                            line["rounds_in_window"] = riw
                     with open(progress, "a") as f:
                         f.write(json.dumps(line) + "\n")
 

@@ -1,0 +1,304 @@
+"""Device-resident fused rounds (ISSUE 17): K saturation rounds per
+dispatch.
+
+The soundness claim under test: a fused run — ``lax.while_loop`` over
+up to K rounds inside ONE device dispatch, tier pick (dense vs sparse)
+and convergence test evaluated ON DEVICE from device-resident frontier
+stats — retires a per-round (iteration, derivations, changed) sequence
+BYTE-IDENTICAL to the per-round adaptive controller's, and lands
+byte-identical final closures.  That holds because the fused program's
+round body IS the per-round machinery (``_step`` for dense rounds, the
+shared ``_sparse_exec`` for sparse rounds) and the device tier test
+replicates the host controller's density/hysteresis arithmetic with an
+exact integer cutoff; a round whose frontier overflows the traced
+sparse capacity rung falls OUT to the host path for that window (never
+silently truncates).
+
+Also pinned: K=1 routes through the unchanged per-round controller
+(byte-identity is by construction, asserted anyway), and the dispatch
+COLLAPSE is real — counted at the jit-call sites by
+``DISPATCH_EVENTS``, K rounds retire per device launch instead of one.
+"""
+
+import numpy as np
+import pytest
+
+from distel_tpu.core.engine import fetch_global
+from distel_tpu.core.indexing import index_ontology
+from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine
+from distel_tpu.frontend.normalizer import normalize
+from distel_tpu.frontend.ontology_tools import chain_tailed_ontology
+from distel_tpu.owl import parser
+
+from sharding_support import requires_shard_map
+
+
+@pytest.fixture(scope="module")
+def galen_idx():
+    """Chain-tailed GALEN shape — late rounds derive one chain hop
+    each, so a run has enough rounds for multiple K=4 windows.  The
+    DisjointClasses axiom makes part of the chain unsatisfiable, so the
+    engines build with ⊥ present and the fused program's CR5 branch is
+    traced and exercised by every parity assertion below."""
+    text = chain_tailed_ontology(400, 12)
+    text += "\nDisjointClasses(TailChain3 TailChain7)"
+    return index_ontology(normalize(parser.parse(text)))
+
+
+#: forces every post-warmup round sparse — the strictest exercise of
+#: the on-device tier pick + compaction (same knob the sparse-tail and
+#: sharded parity fixtures use)
+_ALL_SPARSE = {"density_threshold": 1.1, "hysteresis_rounds": 1}
+
+#: forces every round dense — the device tier test must agree with the
+#: host that nothing ever goes below threshold
+_ALL_DENSE = {"density_threshold": 0.0, "hysteresis_rounds": 1}
+
+#: one tiny sparse rung: busy rounds overflow the traced capacity and
+#: must fall out of the fused window to the host path for that round
+_OVERFLOW = {
+    "density_threshold": 1.1,
+    "hysteresis_rounds": 1,
+    "capacity_buckets": 1,
+    "capacity_floor": 8,
+}
+
+
+def _run(idx, *, mesh=None, sparse=True, fused=None, depth=1):
+    engine = RowPackedSaturationEngine(
+        idx, unroll=1, bucket=True, mesh=mesh
+    )
+    rounds = []
+    res = engine.saturate_observed(
+        observer=lambda it, d, ch: rounds.append((it, d, ch)),
+        sparse_tail=sparse,
+        fused_rounds=fused,
+        pipeline={"enable": depth > 1, "depth": depth},
+    )
+    return engine, rounds, res
+
+
+def _closure(res):
+    return tuple(
+        np.asarray(a)
+        for a in fetch_global((res.packed_s, res.packed_r))
+    )
+
+
+def _assert_same_closure(res_a, res_b):
+    ca, cb = _closure(res_a), _closure(res_b)
+    assert np.array_equal(ca[0], cb[0])
+    assert np.array_equal(ca[1], cb[1])
+
+
+def _dispatch_deltas():
+    """Before/after snapshot context for the process-global dispatch
+    counters."""
+    from distel_tpu.runtime.instrumentation import DISPATCH_EVENTS
+
+    before = DISPATCH_EVENTS.snapshot()
+
+    def delta():
+        after = DISPATCH_EVENTS.snapshot()
+        return {
+            k: after[k] - before[k]
+            for k in before
+            if k != "last_window_rounds"
+        }
+
+    return delta
+
+
+# ------------------------------------------------- K=1 byte-identity
+
+
+def test_k1_routes_through_per_round_controller(galen_idx):
+    """fused.rounds.k=1 is the per-round adaptive controller — same
+    retired sequence, same closure, NO fused windows dispatched."""
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_SPARSE)
+    delta = _dispatch_deltas()
+    eng, k1_rounds, res_1 = _run(
+        galen_idx, sparse=_ALL_SPARSE, fused={"rounds": 1}
+    )
+    d = delta()
+    assert k1_rounds == base_rounds
+    _assert_same_closure(res_b, res_1)
+    assert d["fused_windows"] == 0
+    # per-round telemetry says per-round: no window ever spans > 1
+    assert all(
+        st.rounds_in_window == 1 for st in eng.frontier_rounds
+    )
+
+
+def test_k1_dense_and_pipelined_identity(galen_idx):
+    """K=1 under the dense-only config and under speculative pipelining
+    (depth 3) both match the controller with fusing unconfigured."""
+    for sparse, depth in ((_ALL_DENSE, 1), (_ALL_SPARSE, 3)):
+        _, base_rounds, res_b = _run(galen_idx, sparse=sparse, depth=depth)
+        _, k1_rounds, res_1 = _run(
+            galen_idx, sparse=sparse, fused={"rounds": 1}, depth=depth
+        )
+        assert k1_rounds == base_rounds
+        _assert_same_closure(res_b, res_1)
+
+
+# ------------------------------------ K>1 retired-sequence identity
+
+
+@pytest.mark.parametrize("k", (2, 4))
+def test_fused_sparse_interleave_matches_per_round(galen_idx, k):
+    """THE parity fixture: all-sparse fused windows retire the exact
+    per-round sequence and closure of the per-round controller, K
+    rounds per dispatch."""
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_SPARSE)
+    delta = _dispatch_deltas()
+    eng, f_rounds, res_f = _run(
+        galen_idx, sparse=_ALL_SPARSE, fused={"rounds": k}
+    )
+    d = delta()
+    assert f_rounds == base_rounds
+    _assert_same_closure(res_b, res_f)
+    # the collapse is counted, not inferred: windows actually retired
+    # multiple rounds each, and the per-round launches that remain
+    # (host replays, window remainders) are far fewer than the
+    # per-round controller would have paid
+    assert d["fused_windows"] >= 1
+    assert d["fused_rounds_retired"] >= d["fused_windows"]
+    per_round_launches = d["dense_dispatches"] + d["sparse_dispatches"]
+    assert (
+        per_round_launches + d["fused_windows"] < len(base_rounds)
+    )
+    # telemetry: fused-window rounds carry the retired window size
+    riws = [st.rounds_in_window for st in eng.frontier_rounds]
+    assert max(riws) > 1
+    assert len(riws) == len(f_rounds)
+
+
+@pytest.mark.parametrize("k", (2, 4))
+def test_fused_dense_only_matches_per_round(galen_idx, k):
+    """Dense-only fused windows (threshold 0 keeps every round dense on
+    device) — per-round identity and closure parity."""
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_DENSE)
+    _, f_rounds, res_f = _run(
+        galen_idx, sparse=_ALL_DENSE, fused={"rounds": k}
+    )
+    assert f_rounds == base_rounds
+    _assert_same_closure(res_b, res_f)
+
+
+def test_fused_overflow_falls_out_to_host(galen_idx):
+    """A one-rung tiny-floor roster: busy rounds overflow the traced
+    capacity INSIDE the window, the window stops early with the
+    overflowing round NOT retired, and the host replays it through the
+    full adaptive round (dense fallback) — parity holds, work is never
+    dropped."""
+    eng_b, base_rounds, res_b = _run(galen_idx, sparse=_OVERFLOW)
+    eng, f_rounds, res_f = _run(
+        galen_idx, sparse=_OVERFLOW, fused={"rounds": 4}
+    )
+    assert f_rounds == base_rounds
+    _assert_same_closure(res_b, res_f)
+    sts = eng.frontier_rounds
+    # host-replayed rounds surface as singleton windows; fused windows
+    # still retire multi-round batches around them
+    assert any(st.rounds_in_window == 1 for st in sts)
+    assert any(st.rounds_in_window > 1 for st in sts)
+    # the per-round baseline flags overflow on its dense fallbacks;
+    # the fused run's replayed rounds are those same rounds
+    assert any(st.overflow for st in eng_b.frontier_rounds)
+
+
+def test_fused_pipelined_matches_per_round(galen_idx):
+    """Speculative window dispatch (depth 3): chained fused windows
+    retire the same sequence as the synchronous per-round controller."""
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_SPARSE)
+    _, f_rounds, res_f = _run(
+        galen_idx, sparse=_ALL_SPARSE, fused={"rounds": 4}, depth=3
+    )
+    assert f_rounds == base_rounds
+    _assert_same_closure(res_b, res_f)
+
+
+# ------------------------------------------------------- mesh parity
+
+
+@pytest.fixture(scope="module")
+def _devices():
+    import jax
+
+    return jax.devices()
+
+
+def _mesh(devices, n):
+    import jax
+
+    if len(devices) < n:
+        pytest.skip(f"needs {n} virtual devices (see conftest.py)")
+    return jax.sharding.Mesh(np.array(devices[:n]), ("c",))
+
+
+@requires_shard_map
+@pytest.mark.parametrize("shards", (1, 2, 4))
+@pytest.mark.parametrize("k", (2, 4))
+def test_fused_mesh_matches_local_per_round(galen_idx, _devices, shards, k):
+    """Sharded fused windows (per-round psums inside the device loop,
+    only the window-edge fold reaching the host) retire the
+    single-device per-round controller's exact sequence and closures
+    at 1/2/4 shards."""
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_SPARSE)
+    _, f_rounds, res_f = _run(
+        galen_idx,
+        mesh=_mesh(_devices, shards),
+        sparse=_ALL_SPARSE,
+        fused={"rounds": k},
+    )
+    assert f_rounds == base_rounds
+    _assert_same_closure(res_b, res_f)
+
+
+@requires_shard_map
+def test_fused_mesh_pipelined(galen_idx, _devices):
+    """2-shard fused windows under speculative dispatch (depth 2)."""
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_SPARSE)
+    _, f_rounds, res_f = _run(
+        galen_idx,
+        mesh=_mesh(_devices, 2),
+        sparse=_ALL_SPARSE,
+        fused={"rounds": 4},
+        depth=2,
+    )
+    assert f_rounds == base_rounds
+    _assert_same_closure(res_b, res_f)
+
+
+# ------------------------------------------------ config plumbing
+
+
+def test_fused_config_normalization():
+    eng_cfg = RowPackedSaturationEngine._normalize_fused_cfg
+    assert eng_cfg(None) == {"enable": True, "rounds": 1}
+    assert eng_cfg(True) == {"enable": True, "rounds": 1}
+    assert eng_cfg(False) is None
+    assert eng_cfg({"rounds": 4})["rounds"] == 4
+    assert eng_cfg({"enable": False, "rounds": 4}) is None
+    with pytest.raises(ValueError):
+        eng_cfg({"rounds": 0})
+    with pytest.raises(ValueError):
+        eng_cfg({"bogus": 1})
+
+
+def test_fused_config_reaches_engine_through_make_engine(
+    galen_idx, tmp_path
+):
+    from distel_tpu.config import ClassifierConfig
+    from distel_tpu.runtime.classifier import make_engine
+
+    props = tmp_path / "distel.properties"
+    props.write_text("fused.rounds.enable = true\nfused.rounds.k = 4\n")
+    cfg = ClassifierConfig.from_properties(str(props))
+    assert cfg.fused_rounds_config() == {"enable": True, "rounds": 4}
+    engine = make_engine(cfg, galen_idx)
+    assert engine._fused_cfg == {"enable": True, "rounds": 4}
+    props.write_text("fused.rounds.enable = false\n")
+    off = ClassifierConfig.from_properties(str(props))
+    assert off.fused_rounds_config() is None
