@@ -1115,6 +1115,175 @@ def run_retract_repair(*, classes_list=(2000, 4000)) -> dict:
     }
 
 
+_FARM_CONSUMER = r"""
+import json, sys, time
+
+from distel_tpu.core import artifacts
+from distel_tpu.core.artifacts import ARTIFACT_EVENTS
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+farm, base_path, delta_text = sys.argv[1], sys.argv[2], sys.argv[3]
+install = None
+if farm != "-":
+    t0 = time.monotonic()
+    install = artifacts.install(farm, require=True)
+    install["install_s"] = round(time.monotonic() - t0, 3)
+with open(base_path, encoding="utf-8") as f:
+    base_text = f.read()
+inc = IncrementalClassifier()
+inc._FAST_PATH_MIN_CONCEPTS = 0
+t0 = time.monotonic()
+inc.add_text(base_text)
+first_classify_s = time.monotonic() - t0
+load = dict(inc.history[-1])
+t0 = time.monotonic()
+inc.add_text(delta_text)
+first_delta_s = time.monotonic() - t0
+delta = dict(inc.history[-1])
+tax = extract_taxonomy(inc.last_result)
+print("BENCH_RESULT " + json.dumps({
+    "first_classify_s": round(first_classify_s, 3),
+    "first_delta_s": round(first_delta_s, 3),
+    "load_compile_s": load.get("compile_s", 0.0),
+    "delta_compile_s": delta.get("compile_s", 0.0),
+    "delta_path": delta.get("path"),
+    "install": install,
+    "artifact_events": ARTIFACT_EVENTS.snapshot(),
+    "digest": json.dumps(
+        {c: sorted(s) for c, s in tax.subsumers.items()}, sort_keys=True
+    ),
+}))
+"""
+
+
+def run_artifact_farm(*, classes: int) -> dict:
+    """AOT artifact farm A/B (ISSUE 18): cold-PROCESS first-classify
+    and first-delta walls before vs after a ``cli farm-build`` bake.
+    Each leg is a genuinely fresh subprocess pointed at its own EMPTY
+    persistent compile cache, so the before leg is a true cold start
+    and the after leg's only warm source is the farm itself.  The bake
+    runs through the real CLI (the operational path), per-tier
+    attribution rides in-record from the manifest + the counted
+    ``ARTIFACT_EVENTS``, and the closure byte-identity contract is
+    asserted in-bench — a farm may only ever remove compile seconds."""
+    import hashlib
+    import subprocess
+
+    from distel_tpu.frontend.ontology_tools import snomed_shaped_ontology
+
+    work = tempfile.mkdtemp(prefix="distel-farm-bench-")
+    base_path = os.path.join(work, "base.ofn")
+    with open(base_path, "w", encoding="utf-8") as f:
+        f.write(snomed_shaped_ontology(n_classes=classes))
+    delta_text = (
+        "SubClassOf(Steady0 Find0)\n"
+        "SubClassOf(SteadyLink0 ObjectSomeValuesFrom(attr0 Find1))"
+    )
+    delta_path = os.path.join(work, "delta.ofn")
+    with open(delta_path, "w", encoding="utf-8") as f:
+        f.write(delta_text)
+    farm = os.path.join(work, "farm")
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def consumer(leg: str, farm_arg: str) -> dict:
+        env = dict(os.environ)
+        cache = os.path.join(work, f"jax-cache-{leg}")
+        os.makedirs(cache, exist_ok=True)
+        env["JAX_COMPILATION_CACHE_DIR"] = cache
+        r = subprocess.run(
+            [
+                sys.executable, "-c", _FARM_CONSUMER,
+                farm_arg, base_path, delta_text,
+            ],
+            capture_output=True, text=True, timeout=1800,
+            env=env, cwd=repo,
+        )
+        if r.returncode != 0:
+            raise SystemExit(
+                f"artifact-farm {leg} leg failed:\n{r.stderr[-4000:]}"
+            )
+        line = [
+            ln for ln in r.stdout.splitlines()
+            if ln.startswith("BENCH_RESULT ")
+        ][-1]
+        return json.loads(line[len("BENCH_RESULT "):])
+
+    before = consumer("before", "-")
+
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "distel_tpu.cli", "farm-build",
+            base_path, "--out", farm, "--delta", delta_path,
+        ],
+        capture_output=True, text=True, timeout=1800,
+        env=dict(os.environ), cwd=repo,
+    )
+    if r.returncode != 0:
+        raise SystemExit(f"farm-build failed:\n{r.stderr[-4000:]}")
+    bake = json.loads(r.stdout.splitlines()[-1])
+
+    after = consumer("after", farm)
+
+    # the acceptance contract, asserted where the record is minted
+    assert after["digest"] == before["digest"], "farm changed the closure"
+    assert after["load_compile_s"] == 0.0, after
+    assert after["delta_compile_s"] == 0.0, after
+    assert after["artifact_events"]["exe_hits"] > 0, after
+    assert after["artifact_events"]["misses"] == 0, after
+    assert after["artifact_events"]["rejected"] == 0, after
+
+    with open(
+        os.path.join(farm, "manifest.json"), encoding="utf-8"
+    ) as f:
+        manifest = json.load(f)
+    tiers: dict = {}
+    for a in manifest["artifacts"].values():
+        tiers[a["tier"]] = tiers.get(a["tier"], 0) + 1
+
+    digest = before["digest"]
+    for leg in (before, after):
+        leg.pop("digest", None)
+    return {
+        "scenario": "artifact-farm",
+        "classes": classes,
+        "bake": {
+            k: bake.get(k)
+            for k in (
+                "wall_s", "written", "manifest_written", "artifacts",
+                "exe", "hlo_cache_keys", "hlo_files_adopted", "bytes",
+            )
+        },
+        "tiers_baked": tiers,
+        "hlo_cache_entries": len(manifest.get("hlo_cache") or {}),
+        "before": before,
+        "after": after,
+        "first_classify_speedup_x": round(
+            before["first_classify_s"]
+            / max(after["first_classify_s"], 1e-9),
+            2,
+        ),
+        "first_delta_speedup_x": round(
+            before["first_delta_s"] / max(after["first_delta_s"], 1e-9),
+            2,
+        ),
+        "compile_s_removed": round(
+            before["load_compile_s"] + before["delta_compile_s"], 2
+        ),
+        "closure_identical": True,
+        "closure_digest_sha256": hashlib.sha256(
+            digest.encode()
+        ).hexdigest()[:16],
+        "note": (
+            "walls on this host are saturation-dominated (jax CPU "
+            "programs execute inline): compile_s_removed is the "
+            "honest farm win, the wall speedups understate what the "
+            "same removal buys where the fixed point runs on an "
+            "accelerator"
+        ),
+    }
+
+
 def _parallel_capacity(burn_s: float = 1.5) -> float:
     """Measured parallel speedup of 2 busy processes over 1 — the real
     scaling ceiling of this host (container quotas, SMT siblings, and
@@ -1154,6 +1323,7 @@ KNOWN_SCENARIOS = (
     "read-heavy",
     "spill-compression",
     "retract-repair",
+    "artifact-farm",
     "trace (--trace FILE)",
 )
 
@@ -1192,6 +1362,7 @@ def _check_args(ap, args) -> None:
         "read_classes": "read_heavy",
         "spill_classes": "spill_compression",
         "retract_classes": "retract_repair",
+        "farm_classes": "artifact_farm",
     }
     for flag, owner in owners.items():
         if getattr(args, flag) != ap.get_default(flag) and not getattr(
@@ -1207,6 +1378,7 @@ def _check_args(ap, args) -> None:
         "read_heavy",
         "spill_compression",
         "retract_repair",
+        "artifact_farm",
     )
     if not (
         args.replicas
@@ -1311,6 +1483,14 @@ def main(argv=None) -> int:
     ap.add_argument("--retract-classes", type=int, nargs="*",
                     default=[2000, 4000],
                     help="base ontology sizes for --retract-repair")
+    ap.add_argument("--artifact-farm", action="store_true",
+                    help="AOT artifact farm A/B (ISSUE 18): "
+                         "cold-process first-classify + first-delta "
+                         "walls before vs after a cli farm-build bake, "
+                         "per-tier attribution, byte-identical closure "
+                         "asserted")
+    ap.add_argument("--farm-classes", type=int, default=600,
+                    help="base ontology size for --artifact-farm")
     ap.add_argument("--out", default=None,
                     help="write the JSON record here as well as stdout")
     args = ap.parse_args(argv)
@@ -1368,6 +1548,10 @@ def main(argv=None) -> int:
         rec = run_retract_repair(
             classes_list=tuple(args.retract_classes)
         )
+        print(json.dumps(rec), flush=True)
+        scenarios.append(rec)
+    if args.artifact_farm:
+        rec = run_artifact_farm(classes=args.farm_classes)
         print(json.dumps(rec), flush=True)
         scenarios.append(rec)
     if args.trace:

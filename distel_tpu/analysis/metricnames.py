@@ -84,18 +84,25 @@ def collect_minted(
                 ) and isinstance(sub.args[0].value, str):
                     note(sub.args[0].value, kind, path, sub.lineno)
             elif isinstance(sub, ast.Assign):
-                # gauge tables (`_FRONTIER_GAUGES = ((name, ...), ...)`)
-                # register through gauge_group with computed names —
-                # type their string members by the GAUGE in the target
+                # gauge/counter tables (`_FRONTIER_GAUGES = ...`,
+                # `_ARTIFACT_COUNTERS = ...`) register through
+                # gauge_group/counter_group with computed names — type
+                # their string members by the GAUGE/COUNTER in the
+                # target
                 names = [
                     t.id for t in sub.targets if isinstance(t, ast.Name)
                 ]
+                table_kind = None
                 if any("GAUGE" in n.upper() for n in names):
+                    table_kind = "gauge"
+                elif any("COUNTER" in n.upper() for n in names):
+                    table_kind = "counter"
+                if table_kind is not None:
                     for c in ast.walk(sub.value):
                         if isinstance(c, ast.Constant) and isinstance(
                             c.value, str
                         ):
-                            note(c.value, "gauge", path, c.lineno)
+                            note(c.value, table_kind, path, c.lineno)
             elif isinstance(sub, ast.Constant) and isinstance(
                 sub.value, str
             ) and sub.value.startswith("distel_"):

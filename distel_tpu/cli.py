@@ -47,6 +47,14 @@ def cmd_classify(args) -> int:
 
     cfg = _load_cfg(args)
     enable_compile_cache(cfg.compile_cache_dir)
+    warm_farm = False
+    if args.artifacts_dir:
+        from distel_tpu.core import artifacts
+
+        cfg.artifacts_dir = args.artifacts_dir
+        rec = artifacts.install_from_config(cfg)
+        warm_farm = bool(rec and rec.get("installed"))
+        print(json.dumps({"artifacts": rec}), flush=True)
     if args.mesh:
         cfg.mesh_devices = args.mesh
     cfg.instrumentation = args.instrument
@@ -72,7 +80,8 @@ def cmd_classify(args) -> int:
         )
         n = ontology_stats(args.ontology)["classes"]
         guard = costmodel.guard_launch(
-            model, n, args.budget_s, force=args.force
+            model, n, args.budget_s, force=args.force,
+            warm_artifacts=warm_farm,
         )
         print(json.dumps({"launch_guard": guard}), flush=True)
         if not guard["allowed"]:
@@ -470,6 +479,16 @@ def cmd_warmup(args) -> int:
     # land on disk too
     os.environ.setdefault("DISTEL_CACHE_MIN_COMPILE_S", "0")
     enable_compile_cache(cfg.compile_cache_dir)
+    if args.artifacts_dir:
+        # consume a farm during warmup: rosters the manifest covers
+        # resolve as artifact hits instead of compiling
+        from distel_tpu.core import artifacts
+
+        cfg.artifacts_dir = args.artifacts_dir
+        print(
+            json.dumps({"artifacts": artifacts.install_from_config(cfg)}),
+            flush=True,
+        )
     t0 = time.time()
     recs = warmup_paths(
         args.ontologies,
@@ -504,8 +523,122 @@ def cmd_warmup(args) -> int:
                 "delta_compile_s": round(
                     sum(r.get("delta_compile_s", 0) for r in recs), 2
                 ),
+                # the AOT farm's share of the roster (ISSUE 18)
+                "artifact_exe_hits": sum(
+                    r.get("artifact_exe_hits", 0) for r in recs
+                ),
+                "artifact_hlo_hits": sum(
+                    r.get("artifact_hlo_hits", 0) for r in recs
+                ),
             }
         )
+    )
+    return 0
+
+
+def cmd_farm_build(args) -> int:
+    """AOT artifact farm bake (ISSUE 18): warm the canonical program
+    roster for each sample corpus and serialize every build into a
+    distributable artifact directory — serialized executables where the
+    pin allows, byte-identical persistent-compile-cache entries where
+    it doesn't.  Point serving processes at the output with
+    ``--artifacts-dir`` (or drop it at ``<spill_dir>/artifacts`` and
+    the fleet supervisor wires it automatically) and no process ever
+    cold-compiles those programs again.  Idempotent: a second bake over
+    the same roster writes nothing (``written == 0``)."""
+    from distel_tpu.config import enable_compile_cache
+    from distel_tpu.core import artifacts
+    from distel_tpu.core.program_cache import PROGRAMS
+    from distel_tpu.runtime.warmup import warmup_paths
+
+    cfg = _load_cfg(args)
+    out = os.path.abspath(args.out)
+    xla_dir = os.path.join(out, "xla")
+    os.makedirs(xla_dir, exist_ok=True)
+    # the bake's persistent-cache entries ARE the hlo-cache tier: point
+    # the jax cache INSIDE the farm and drop the persistence floor so
+    # every compile of the bake lands on the wire
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = xla_dir
+    os.environ.setdefault("DISTEL_CACHE_MIN_COMPILE_S", "0")
+    enable_compile_cache(cfg.compile_cache_dir)
+    try:
+        store = artifacts.ArtifactStore(out, writable=True)
+    except artifacts.ArtifactError as e:
+        print(f"refusing farm-build: {e}", file=sys.stderr)
+        return 3
+    mismatch = store.env_mismatch()
+    if mismatch is not None:
+        # extending someone else's farm would mix environments in one
+        # manifest — bake a fresh directory instead
+        print(f"refusing farm-build: {mismatch}", file=sys.stderr)
+        return 3
+    # source AND sink: a re-bake resolves the roster off the existing
+    # artifacts (nothing rebuilds, nothing rewrites); fresh keys build
+    # once and serialize through the sink
+    PROGRAMS.artifact_source = store
+    PROGRAMS.artifact_sink = store
+    t0 = time.time()
+    try:
+        recs = warmup_paths(
+            args.ontologies,
+            cfg,
+            profile=args.profile,
+            max_iters=args.max_iters,
+            parallel=not args.serial,
+        )
+        if args.delta:
+            # replay a representative increment per corpus with the
+            # sink still attached: a growing delta re-buckets the
+            # engine into a shape no from-scratch warmup reaches
+            # (padded base dims + delta rows), and those growth-bucket
+            # programs must ride the wire too or a consumer's FIRST
+            # delta compiles.  fast_path_min_concepts=0 forces the
+            # delta plane regardless of corpus size — the replay bakes
+            # a superset of what any consumer threshold needs.
+            from dataclasses import replace as _dc_replace
+
+            from distel_tpu.core.incremental import IncrementalClassifier
+
+            with open(args.delta, encoding="utf-8") as f:
+                delta_text = f.read()
+            rcfg = _dc_replace(cfg, fast_path_min_concepts=0)
+            for path in args.ontologies:
+                with open(path, encoding="utf-8") as f:
+                    corpus = f.read()
+                td = time.time()
+                inc = IncrementalClassifier(rcfg)
+                inc.add_text(corpus)
+                inc.add_text(delta_text)
+                recs.append(
+                    {
+                        "profile": "delta-replay",
+                        "file": path,
+                        "delta": args.delta,
+                        "path": inc.history[-1].get("path"),
+                        "compile_s": inc.history[-1].get("compile_s"),
+                        "wall_s": round(time.time() - td, 3),
+                    }
+                )
+    finally:
+        PROGRAMS.artifact_sink = None
+        PROGRAMS.artifact_source = None
+    for rec in recs:
+        print(json.dumps(rec), flush=True)
+    adopted = store.adopt_hlo_cache(xla_dir)
+    wrote_manifest = store.flush()
+    print(
+        json.dumps(
+            {
+                "farm": out,
+                "manifest": os.path.join(out, artifacts.MANIFEST_NAME),
+                "manifest_written": wrote_manifest,
+                "hlo_files_adopted": adopted,
+                "corpora": len(recs),
+                "wall_s": round(time.time() - t0, 2),
+                **store.stats(),
+            }
+        ),
+        flush=True,
     )
     return 0
 
@@ -519,6 +652,10 @@ def cmd_serve(args) -> int:
 
     cfg = _load_cfg(args)
     enable_compile_cache(cfg.compile_cache_dir)
+    if args.artifacts_dir:
+        cfg.artifacts_dir = args.artifacts_dir
+    if args.artifacts_require:
+        cfg.artifacts_require = True
     budget = (
         int(args.memory_budget_mb * (1 << 20))
         if args.memory_budget_mb is not None
@@ -586,9 +723,12 @@ def cmd_fleet(args) -> int:
         ("--memory-budget-mb", args.memory_budget_mb),
         ("--warm-budget-mb", args.warm_budget_mb),
         ("--fast-path-min-concepts", args.fast_path_min_concepts),
+        ("--artifacts-dir", args.artifacts_dir),
     ):
         if val is not None:
             extra += [flag, str(val)]
+    if args.artifacts_require:
+        extra += ["--artifacts-require"]
     if args.warmup:
         extra += ["--warmup", *args.warmup]
     sup = ReplicaSupervisor(
@@ -906,6 +1046,11 @@ def main(argv=None) -> int:
                         "prediction exceeds this many seconds")
     c.add_argument("--force", action="store_true",
                    help="launch past a failed --budget-s guard")
+    c.add_argument("--artifacts-dir", default=None,
+                   help="consume a farm-build output: covered bucket "
+                        "programs deserialize instead of compiling, "
+                        "and the --budget-s guard drops its compile "
+                        "term")
     c.add_argument("--model-from", nargs="*", default=None,
                    metavar="FILE",
                    help="probe/ledger files the cost model fits from "
@@ -995,6 +1140,15 @@ def main(argv=None) -> int:
                          "the /fleet admin plane (load-with-id, "
                          "migrate-out, adopt) the router drives; "
                          "requires --spill-dir")
+    sv.add_argument("--artifacts-dir", default=None,
+                    help="consume a farm-build output: bucketed "
+                         "programs the manifest covers deserialize "
+                         "instead of compiling (compile_s == 0 on "
+                         "first request)")
+    sv.add_argument("--artifacts-require", action="store_true",
+                    help="refuse to start when the artifact farm "
+                         "cannot be installed (default: warn and "
+                         "compile)")
     sv.set_defaults(fn=cmd_serve)
 
     fl = sub.add_parser(
@@ -1038,6 +1192,14 @@ def main(argv=None) -> int:
                     help="sample corpora every replica precompiles at "
                          "startup (persistent-cache shared: the first "
                          "replica compiles, the rest deserialize)")
+    fl.add_argument("--artifacts-dir", default=None,
+                    help="farm directory every replica consumes "
+                         "(default: auto-discovered at "
+                         "<spill_dir>/artifacts when its manifest "
+                         "exists)")
+    fl.add_argument("--artifacts-require", action="store_true",
+                    help="replicas refuse to start without a usable "
+                         "artifact farm")
     fl.set_defaults(fn=cmd_fleet)
 
     w = sub.add_parser(
@@ -1059,7 +1221,45 @@ def main(argv=None) -> int:
                         "max_iterations; default: config)")
     w.add_argument("--serial", action="store_true",
                    help="compile buckets one at a time (debugging)")
+    w.add_argument("--artifacts-dir", default=None,
+                   help="consume a farm-build output while warming: "
+                        "covered rosters deserialize instead of "
+                        "compiling")
     w.set_defaults(fn=cmd_warmup)
+
+    fb = sub.add_parser(
+        "farm-build",
+        help="AOT artifact farm: pre-bake the bucket-program roster "
+             "for sample corpora into a distributable directory "
+             "(serialized executables + persistent-cache entries) "
+             "that serving processes consume via --artifacts-dir",
+    )
+    fb.add_argument("ontologies", nargs="+",
+                    help="one sample corpus per bucket to bake")
+    fb.add_argument("--out", required=True,
+                    help="farm output directory (manifest.json + "
+                         "exe/ + xla/); ship it to "
+                         "<spill_dir>/artifacts for fleet "
+                         "auto-discovery")
+    fb.add_argument("--config", help="properties/config file")
+    fb.add_argument("--profile", choices=("serve", "classify"),
+                    default="serve",
+                    help="which construction's programs to bake "
+                         "(default: the serve/incremental roster)")
+    fb.add_argument("--max-iters", type=int, default=None,
+                    help="fixed-point budget (must match consumers; "
+                         "default: config)")
+    fb.add_argument("--delta", metavar="FILE", default=None,
+                    help="representative increment to replay against "
+                         "each corpus during the bake: growth-bucket "
+                         "programs (a delta whose links spill past the "
+                         "base rung re-buckets the engine into a "
+                         "shape no from-scratch sample reaches) land "
+                         "in the farm too, so a consumer's first "
+                         "delta is also compile-free")
+    fb.add_argument("--serial", action="store_true",
+                    help="bake buckets one at a time (debugging)")
+    fb.set_defaults(fn=cmd_farm_build)
 
     pr = sub.add_parser(
         "profile",

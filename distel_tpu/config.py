@@ -195,6 +195,25 @@ class ClassifierConfig:
     #: (the fused program is never built); raise on hosts where the
     #: per-round host round-trip dominates the retire wall.
     fused_rounds_k: int = 1
+    #: K-adaptive terminal window: once the derivation tail's geometric
+    #: decay predicts fewer remaining rounds than a full window would
+    #: speculate, the controller halves K down the power-of-two ladder
+    #: (K, K/2, ..., 2) for the next window — the last windows waste
+    #: fewer speculative fixed-point rounds.  Retired rounds stay
+    #: byte-identical (only window boundaries move); each ladder K is
+    #: its own registry program (warmed by precompile/farm-build).
+    fused_rounds_adaptive: bool = False
+    #: AOT artifact farm (``core/artifacts.py``): directory holding a
+    #: ``cli farm-build`` output — serialized executables + shipped
+    #: compile-cache entries under a checksummed manifest.  Set, every
+    #: entry point installs it over the program registry so covered
+    #: programs load with zero trace/compile; unset (None) = compile
+    #: as before.
+    artifacts_dir: Optional[str] = None
+    #: fail startup when ``artifacts_dir`` is set but the manifest is
+    #: missing/corrupt or was baked under a different backend/jax
+    #: pin/device count (default: warn loudly and fall back to compile)
+    artifacts_require: bool = False
     #: serve fleet (``serve/fleet/``): replica processes behind the
     #: router — shared-nothing scale-out of the serve plane (the
     #: reference's NODES_LIST, but processes on one host instead of
@@ -383,6 +402,16 @@ class ClassifierConfig:
             )
         if "fused.rounds.k" in raw:
             cfg.fused_rounds_k = int(raw["fused.rounds.k"])
+        if "fused.rounds.adaptive" in raw:
+            cfg.fused_rounds_adaptive = (
+                raw["fused.rounds.adaptive"].lower() == "true"
+            )
+        if "artifacts.dir" in raw:
+            cfg.artifacts_dir = raw["artifacts.dir"]
+        if "artifacts.require" in raw:
+            cfg.artifacts_require = (
+                raw["artifacts.require"].lower() == "true"
+            )
         if "fleet.replicas" in raw:
             cfg.fleet_replicas = int(raw["fleet.replicas"])
         if "fleet.depth.divergence" in raw:
@@ -496,6 +525,7 @@ class ClassifierConfig:
         return {
             "enable": True,
             "rounds": self.fused_rounds_k,
+            "adaptive": self.fused_rounds_adaptive,
         }
 
     def tracer_kwargs(self) -> dict:

@@ -1,0 +1,539 @@
+"""AOT artifact farm: a distributable compiled-program registry.
+
+The PROGRAMS registry (``core/program_cache.py``) and the persistent
+XLA compile cache amortize compilation across *resumes of one host* —
+but every fresh process (a respawned fleet replica, an autoscaled
+worker, a cold CI runner) still pays the full trace+compile roster
+before its first request.  The reference system never had this wall:
+DistEL's Redis-side Lua "programs" are source-shipped and loaded in
+milliseconds.  Pushing the EL Envelope's fixed rule set means the
+canonical program roster is finite and enumerable ahead of time, so a
+one-shot **compile farm** (``cli farm-build``) can pre-bake it and
+ship the results to every serving process.
+
+Two artifact tiers, recorded per-entry in the manifest:
+
+* ``"exe"`` — the compiled executable itself, serialized through
+  ``jax.experimental.serialize_executable`` (the jax AOT export path).
+  A consumer deserializes and serves it with ZERO trace/lower/compile:
+  ``CompileStats.compile_s == 0.0`` on the first request.
+* ``"hlo-cache"`` — for program kinds the pin cannot serialize, the
+  farm ships the byte-identical persistent-compile-cache entries
+  instead (same keying).  The consumer still pays trace+lower, but the
+  XLA pass becomes a disk-cache deserialization.
+
+Keying: an artifact id is a sha256 over the PROGRAMS registry key —
+``(bucket_signature, program_kind, rung/capacity extras...)`` — plus
+the runtime environment ``(backend, jax_version, n_devices)``.  The
+bucket signature already folds every structural determinant of the
+traced program (shapes, rule-group presence, mesh axis), so two
+processes that would build the same program resolve to the same
+artifact, and NOTHING else does.  The manifest is checksummed per-file
+and as a whole; a corrupt entry, or a manifest baked on a different
+backend / jax pin / device count, is rejected LOUDLY (a warning + a
+counted rejection) and the consumer falls back to compiling — stale
+artifacts can cost time, never correctness.
+
+Artifact files are pickles (payload bytes + in/out tree defs).  Load
+is opt-in (``artifacts.dir`` / ``--artifacts-dir``) and every file's
+sha256 is verified against the checksummed manifest before
+unpickling — the manifest is the trust root; point it only at farm
+output you produced.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import warnings
+from typing import Dict, Optional, Tuple
+
+MANIFEST_NAME = "manifest.json"
+FORMAT_VERSION = 1
+
+#: manifest fields covered by the whole-manifest digest, in canonical
+#: order (everything except the digest itself)
+_DIGEST_FIELDS = (
+    "format", "backend", "jax_version", "n_devices", "artifacts",
+    "hlo_cache",
+)
+
+
+class ArtifactError(RuntimeError):
+    """A farm directory that cannot be trusted: unreadable/corrupt
+    manifest, checksum mismatch, or an environment mismatch under
+    ``require=True``."""
+
+
+class ArtifactAggregate:
+    """Process-global artifact-event tallies (thread-safe), one per
+    process like the dispatch/frontier aggregates in
+    ``runtime/instrumentation.py``.  The serve plane renders them as
+    the ``distel_artifact_*`` counter families; the farm smoke and the
+    cross-process tests assert on THESE — counted hits, never
+    wall-clock inference."""
+
+    _FIELDS = (
+        "exe_hits", "hlo_hits", "misses", "rejected", "serialized",
+        "unserializable",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            for f in self._FIELDS:
+                setattr(self, f, 0)
+
+    def record(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {f: getattr(self, f) for f in self._FIELDS}
+
+
+#: THE process-global tally (one per process, like PROGRAMS)
+ARTIFACT_EVENTS = ArtifactAggregate()
+
+
+def runtime_env() -> Dict[str, object]:
+    """The environment half of the artifact key: a serialized
+    executable embeds its backend's device assignment and the
+    serializer's wire format follows the jax pin, so artifacts are
+    valid only under the exact ``(backend, jax_version, n_devices)``
+    they were baked with."""
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "n_devices": jax.device_count(),
+    }
+
+
+def artifact_id(key: Tuple) -> str:
+    """Stable id from the PROGRAMS registry key.  ``repr`` of the key
+    tuple is deterministic here: keys are built from str/int/tuple
+    structural metadata only (the same property ``signature_of``
+    already leans on)."""
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:32]
+
+
+def describe_key(key: Tuple) -> Dict[str, object]:
+    """Human-greppable manifest fields best-effort extracted from a
+    registry key ``(bucket_signature, program_kind, extras...)`` —
+    reporting only; the id hashes the full key."""
+    desc: Dict[str, object] = {"key": repr(key)}
+    if isinstance(key, tuple) and key:
+        if isinstance(key[0], str):
+            desc["bucket_signature"] = key[0]
+        if len(key) > 1 and isinstance(key[1], str):
+            desc["kind"] = key[1]
+            if key[1] == "fused" and len(key) > 2 and isinstance(
+                key[2], tuple
+            ) and key[2]:
+                desc["fused_k"] = int(key[2][0])
+            if key[1] == "sparse" and len(key) > 2 and isinstance(
+                key[2], tuple
+            ):
+                desc["rung"] = list(map(int, key[2]))
+            if key[1] == "cohort_run" and len(key) > 3:
+                desc["rung"] = int(key[3])
+    return desc
+
+
+def _sha256_bytes(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest_digest(doc: dict) -> str:
+    body = json.dumps(
+        {f: doc.get(f) for f in _DIGEST_FIELDS}, sort_keys=True
+    )
+    return _sha256_bytes(body.encode())
+
+
+def _serialize_exe(exe) -> bytes:
+    """Compiled executable -> artifact file bytes.  Raises whatever
+    the pin raises for unserializable kinds — the caller downgrades
+    those keys to the hlo-cache tier."""
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = se.serialize(exe)
+    return pickle.dumps(
+        {"payload": payload, "in_tree": in_tree, "out_tree": out_tree},
+        protocol=4,
+    )
+
+
+def _deserialize_exe(blob: bytes):
+    from jax.experimental import serialize_executable as se
+
+    doc = pickle.loads(blob)
+    return se.deserialize_and_load(
+        doc["payload"], doc["in_tree"], doc["out_tree"]
+    )
+
+
+class ArtifactStore:
+    """One farm directory: ``manifest.json`` + ``exe/<id>.bin`` +
+    ``xla/`` (shipped persistent-compile-cache entries).
+
+    Read side (a consuming replica): :meth:`load` under the PROGRAMS
+    per-key build lock — deserialize on a manifest hit, reject loudly
+    on corruption.  Write side (``cli farm-build``): :meth:`save` as
+    the registry's post-build sink, :meth:`adopt_hlo_cache` +
+    :meth:`flush` at the end of the bake.  Thread-safe: warmup builds
+    the roster on a thread pool."""
+
+    def __init__(self, root: str, writable: bool = False):
+        self.root = os.path.abspath(root)
+        self.writable = bool(writable)
+        self._lock = threading.Lock()
+        self.written = 0  # artifacts newly serialized by THIS process
+        self._warned: set = set()
+        mpath = os.path.join(self.root, MANIFEST_NAME)
+        if os.path.exists(mpath):
+            try:
+                with open(mpath, "r", encoding="utf-8") as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                raise ArtifactError(
+                    f"unreadable artifact manifest {mpath}: {e}"
+                )
+            if doc.get("format") != FORMAT_VERSION:
+                raise ArtifactError(
+                    f"artifact manifest format {doc.get('format')!r} "
+                    f"!= supported {FORMAT_VERSION}"
+                )
+            if _manifest_digest(doc) != doc.get("checksum"):
+                raise ArtifactError(
+                    f"artifact manifest checksum mismatch in {mpath} "
+                    "(tampered or torn write)"
+                )
+            self._doc = doc
+            self._dirty = False
+        elif writable:
+            os.makedirs(os.path.join(self.root, "exe"), exist_ok=True)
+            self._doc = {
+                "format": FORMAT_VERSION,
+                **runtime_env(),
+                "artifacts": {},
+                "hlo_cache": {},
+            }
+            self._dirty = True
+        else:
+            raise ArtifactError(
+                f"no artifact manifest at {mpath} (run `cli farm-build` "
+                "first, or fix --artifacts-dir)"
+            )
+
+    # ------------------------------------------------------------ env
+
+    def env_mismatch(self) -> Optional[str]:
+        """None when this process can consume the store; else the
+        human reason it must not (the caller warns and falls back to
+        compiling)."""
+        env = runtime_env()
+        for k, v in env.items():
+            if self._doc.get(k) != v:
+                return (
+                    f"artifact manifest {k}={self._doc.get(k)!r} != "
+                    f"this process's {v!r}"
+                )
+        return None
+
+    # ---------------------------------------------------------- read
+
+    def covers(self, key: Tuple) -> Optional[str]:
+        """The manifest tier for a registry key (``"exe"`` /
+        ``"hlo-cache"``) or None."""
+        ent = self._doc["artifacts"].get(artifact_id(key))
+        return ent["tier"] if ent else None
+
+    def load(self, key: Tuple):
+        """Deserialize the artifact for ``key``; None on a miss or a
+        (counted, warned) rejection.  An ``hlo-cache``-tier entry also
+        returns None — the build that follows is served by the shipped
+        persistent-cache entries — but counts as an hlo hit so the
+        bench and the smoke can attribute the tier."""
+        ent = self._doc["artifacts"].get(artifact_id(key))
+        if ent is None:
+            ARTIFACT_EVENTS.record("misses")
+            return None
+        if ent["tier"] == "hlo-cache":
+            ARTIFACT_EVENTS.record("hlo_hits")
+            return None
+        path = os.path.join(self.root, ent["file"])
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            if _sha256_bytes(blob) != ent["sha256"]:
+                raise ArtifactError(
+                    f"artifact {ent['file']} sha256 mismatch"
+                )
+            exe = _deserialize_exe(blob)
+        except Exception as e:
+            ARTIFACT_EVENTS.record("rejected")
+            self._warn_once(
+                ent["file"],
+                f"rejecting artifact {ent['file']} for key "
+                f"{ent.get('kind', '?')}: {e}; falling back to compile",
+            )
+            return None
+        ARTIFACT_EVENTS.record("exe_hits")
+        return exe
+
+    def _warn_once(self, token: str, msg: str) -> None:
+        with self._lock:
+            if token in self._warned:
+                return
+            self._warned.add(token)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    # --------------------------------------------------------- write
+
+    def save(self, key: Tuple, exe) -> str:
+        """Registry post-build sink: serialize ``exe`` under ``key``
+        (or record the key at the hlo-cache tier when the pin can't
+        serialize this executable kind).  Returns the recorded tier;
+        idempotent — a key already in the manifest writes nothing."""
+        if not self.writable:
+            return self._doc["artifacts"].get(
+                artifact_id(key), {}
+            ).get("tier", "")
+        aid = artifact_id(key)
+        with self._lock:
+            ent = self._doc["artifacts"].get(aid)
+        if ent is not None:
+            return ent["tier"]
+        t0 = time.perf_counter()
+        try:
+            blob = _serialize_exe(exe)
+        except Exception as e:
+            ARTIFACT_EVENTS.record("unserializable")
+            ent = {
+                **describe_key(key),
+                "tier": "hlo-cache",
+                "file": None,
+                "reason": f"{type(e).__name__}: {e}",
+            }
+            with self._lock:
+                self._doc["artifacts"].setdefault(aid, ent)
+                self._dirty = True
+            return "hlo-cache"
+        rel = os.path.join("exe", f"{aid}.bin")
+        path = os.path.join(self.root, rel)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        ent = {
+            **describe_key(key),
+            "tier": "exe",
+            "file": rel,
+            "sha256": _sha256_bytes(blob),
+            "bytes": len(blob),
+            "serialize_s": round(time.perf_counter() - t0, 4),
+        }
+        with self._lock:
+            if aid not in self._doc["artifacts"]:
+                self._doc["artifacts"][aid] = ent
+                self.written += 1
+                self._dirty = True
+        ARTIFACT_EVENTS.record("serialized")
+        return "exe"
+
+    def adopt_hlo_cache(self, cache_dir: str) -> int:
+        """Record (and checksum) the persistent-compile-cache entries
+        the bake produced under ``cache_dir`` — ``farm-build`` points
+        the jax cache INSIDE the farm, so these files ARE the
+        byte-identical entries a consumer's compile would produce.
+        Returns the number of newly recorded files."""
+        new = 0
+        if not os.path.isdir(cache_dir):
+            return 0
+        for name in sorted(os.listdir(cache_dir)):
+            path = os.path.join(cache_dir, name)
+            if not os.path.isfile(path):
+                continue
+            with self._lock:
+                if name in self._doc["hlo_cache"]:
+                    continue
+                self._doc["hlo_cache"][name] = {
+                    "sha256": _sha256_file(path),
+                    "bytes": os.path.getsize(path),
+                }
+                self._dirty = True
+            new += 1
+        return new
+
+    def flush(self) -> bool:
+        """Write the manifest iff something changed (the idempotence
+        contract: a second farm-build over the same roster writes
+        nothing).  Returns whether a write happened."""
+        with self._lock:
+            if not self._dirty:
+                return False
+            doc = dict(self._doc)
+            doc["checksum"] = _manifest_digest(doc)
+            path = os.path.join(self.root, MANIFEST_NAME)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+            self._doc = doc
+            self._dirty = False
+            return True
+
+    # ----------------------------------------------------- hlo ship
+
+    def install_hlo_cache(self, dest_dir: str) -> int:
+        """Copy the shipped persistent-cache entries into the
+        consumer's live compile-cache directory (checksum-verified;
+        corrupt files are skipped loudly).  Returns files copied."""
+        src_dir = os.path.join(self.root, "xla")
+        names = self._doc.get("hlo_cache") or {}
+        if not names or not os.path.isdir(src_dir):
+            return 0
+        os.makedirs(dest_dir, exist_ok=True)
+        copied = 0
+        for name, meta in names.items():
+            dst = os.path.join(dest_dir, name)
+            if os.path.exists(dst):
+                continue
+            src = os.path.join(src_dir, name)
+            try:
+                if _sha256_file(src) != meta["sha256"]:
+                    raise ArtifactError("sha256 mismatch")
+                tmp = f"{dst}.tmp.{os.getpid()}"
+                with open(src, "rb") as fi, open(tmp, "wb") as fo:
+                    fo.write(fi.read())
+                os.replace(tmp, dst)
+                copied += 1
+            except (OSError, ArtifactError) as e:
+                ARTIFACT_EVENTS.record("rejected")
+                self._warn_once(
+                    name,
+                    f"rejecting shipped compile-cache entry {name}: "
+                    f"{e}; that program will compile from scratch",
+                )
+        return copied
+
+    def stats(self) -> dict:
+        arts = self._doc["artifacts"]
+        return {
+            "root": self.root,
+            "artifacts": len(arts),
+            "exe": sum(1 for a in arts.values() if a["tier"] == "exe"),
+            "hlo_cache_keys": sum(
+                1 for a in arts.values() if a["tier"] == "hlo-cache"
+            ),
+            "hlo_cache_files": len(self._doc.get("hlo_cache") or {}),
+            "bytes": sum(a.get("bytes") or 0 for a in arts.values()),
+            "written": self.written,
+        }
+
+
+# ------------------------------------------------------------ install
+
+_ACTIVE: Optional[ArtifactStore] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_store() -> Optional[ArtifactStore]:
+    return _ACTIVE
+
+
+def install(root: str, *, require: bool = False) -> dict:
+    """Attach a farm directory to THE process-global PROGRAMS registry
+    so every bucketed program build first consults the artifact store.
+    Validation happens here, once: a missing/corrupt manifest or an
+    environment mismatch warns loudly (raises under ``require=True``),
+    counts a rejection, and leaves the process compiling as before.
+    Returns the install record serve stamps into its startup line."""
+    global _ACTIVE
+    from distel_tpu.core.program_cache import PROGRAMS
+
+    try:
+        store = ArtifactStore(root, writable=False)
+    except ArtifactError as e:
+        ARTIFACT_EVENTS.record("rejected")
+        if require:
+            raise
+        warnings.warn(
+            f"artifact farm NOT installed: {e}", RuntimeWarning,
+            stacklevel=2,
+        )
+        return {"installed": False, "root": root, "reason": str(e)}
+    reason = store.env_mismatch()
+    if reason is not None:
+        ARTIFACT_EVENTS.record("rejected")
+        if require:
+            raise ArtifactError(reason)
+        warnings.warn(
+            f"artifact farm NOT installed: {reason}; every program "
+            "will compile as if no farm existed",
+            RuntimeWarning, stacklevel=2,
+        )
+        return {"installed": False, "root": root, "reason": reason}
+    # shipped hlo-cache entries land in the live jax cache dir before
+    # any build can want them
+    copied = 0
+    try:
+        import jax
+
+        dest = jax.config.jax_compilation_cache_dir
+        if dest:
+            copied = store.install_hlo_cache(os.path.expanduser(dest))
+    except Exception as e:  # cache ship is an optimization tier
+        warnings.warn(
+            f"could not install shipped compile-cache entries: {e}",
+            RuntimeWarning, stacklevel=2,
+        )
+    with _ACTIVE_LOCK:
+        _ACTIVE = store
+        PROGRAMS.artifact_source = store
+    return {
+        "installed": True,
+        **store.stats(),
+        "hlo_files_copied": copied,
+    }
+
+
+def install_from_config(config) -> Optional[dict]:
+    """The entry-point hook: install ``config.artifacts_dir`` when set
+    (serve/fleet/classify/warmup all funnel through this)."""
+    root = getattr(config, "artifacts_dir", None)
+    if not root:
+        return None
+    return install(
+        root, require=bool(getattr(config, "artifacts_require", False))
+    )
+
+
+def uninstall() -> None:
+    """Detach the active store (tests)."""
+    global _ACTIVE
+    from distel_tpu.core.program_cache import PROGRAMS
+
+    with _ACTIVE_LOCK:
+        _ACTIVE = None
+        PROGRAMS.artifact_source = None

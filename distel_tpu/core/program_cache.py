@@ -108,6 +108,14 @@ class ProgramCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        #: AOT artifact farm hooks (``core/artifacts.py``): ``source``
+        #: is consulted on every registry miss BEFORE ``build`` runs (a
+        #: deserialized artifact counts as a hit — no trace/compile
+        #: happened); ``sink`` captures every freshly built executable
+        #: (``cli farm-build``).  Both survive :meth:`clear` — the
+        #: attachment is process posture, not cached state.
+        self.artifact_source = None
+        self.artifact_sink = None
 
     def _touch(self, key: Tuple, exe) -> None:
         """Re-append for LRU recency.  Caller holds ``self._lock``."""
@@ -147,7 +155,32 @@ class ProgramCache:
                     self.hits += 1
                     self._touch(key, exe)
                     return exe, True
+            src = self.artifact_source
+            if src is not None:
+                exe = src.load(key)
+                if exe is not None:
+                    # a farm artifact: no build ran, so the caller's
+                    # CompileStats stay at compile_s == 0.0 — the same
+                    # contract as an in-process registry hit
+                    with self._lock:
+                        self._programs[key] = exe
+                        self.hits += 1
+                        self._key_locks.pop(key, None)
+                        self._evict_over_capacity()
+                    return exe, True
             exe = build()
+            snk = self.artifact_sink
+            if snk is not None:
+                try:
+                    snk.save(key, exe)
+                except Exception as e:
+                    import warnings
+
+                    # the farm must never break the build it captures
+                    warnings.warn(
+                        f"artifact capture failed for {key[:2]}: {e}",
+                        RuntimeWarning,
+                    )
             with self._lock:
                 self._programs[key] = exe
                 self.misses += 1

@@ -2137,7 +2137,7 @@ class RowPackedSaturationEngine:
         cfg["enable"] = bool(cfg["enable"])
         return cfg
 
-    _FUSED_DEFAULTS = {"enable": True, "rounds": 1}
+    _FUSED_DEFAULTS = {"enable": True, "rounds": 1, "adaptive": False}
 
     @classmethod
     def _normalize_fused_cfg(cls, raw) -> Optional[dict]:
@@ -2166,7 +2166,22 @@ class RowPackedSaturationEngine:
                 f"fused_rounds rounds must be >= 1 (got {cfg['rounds']!r})"
             )
         cfg["rounds"] = int(cfg["rounds"])
+        cfg["adaptive"] = bool(cfg["adaptive"])
         return cfg
+
+    @staticmethod
+    def _fused_k_ladder(K: int, adaptive: bool) -> list:
+        """The window sizes this config can dispatch: just K, or — with
+        the K-adaptive terminal window on — the halving ladder K, K/2,
+        ..., 2 (each rung is its own registry program; the precompile
+        roster and the artifact farm warm them all)."""
+        ks = [int(K)]
+        if adaptive:
+            k = int(K)
+            while k > 2:
+                k //= 2
+                ks.append(k)
+        return ks
 
     def _fused_eligible(self) -> bool:
         """Whether this engine's config actually routes the fused
@@ -3387,7 +3402,9 @@ class RowPackedSaturationEngine:
         self,
         max_iters: int = 10_000,
         *,
-        programs: Tuple[str, ...] = ("run", "step", "sparse", "fused"),
+        programs: Tuple[str, ...] = (
+            "run", "step", "sparse", "fused", "helpers",
+        ),
         parallel: Optional[bool] = None,
         max_workers: Optional[int] = None,
     ) -> CompileStats:
@@ -3442,17 +3459,32 @@ class RowPackedSaturationEngine:
                 def fused_floor():
                     scfg = self._sparse_cfg
                     floor = scfg["capacity_floor"]
-                    self._fused_aot(
-                        self._fused_cfg["rounds"],
-                        (
-                            floor,
-                            floor if self._scan4 else 0,
-                            floor if self._scan6 else 0,
-                        ),
-                        self._fused_run_args(scfg, budget),
+                    caps = (
+                        floor,
+                        floor if self._scan4 else 0,
+                        floor if self._scan6 else 0,
                     )
+                    fargs = self._fused_run_args(scfg, budget)
+                    for k in self._fused_k_ladder(
+                        self._fused_cfg["rounds"],
+                        self._fused_cfg.get("adaptive", False),
+                    ):
+                        self._fused_aot(k, caps, fargs)
 
                 roster["fused"] = fused_floor
+
+            def helpers():
+                # the delta plane's shape-keyed helper programs
+                # (same-bucket embed + live-bit counts): tiny builds,
+                # but a consumer fed by the AOT artifact farm should
+                # build NOTHING — running them here puts their keys on
+                # the farm wire alongside the heavyweights
+                z_sp = jnp.zeros((self.nc, self.wc), jnp.uint32)
+                z_rp = jnp.zeros((self.nl, self.wc), jnp.uint32)
+                self.count_live_bits(z_sp, z_rp)
+                self._embed_packed_device(z_sp, z_rp)
+
+            roster["helpers"] = helpers
             tasks = [roster[name] for name in programs if name in roster]
         else:
 
@@ -3479,15 +3511,17 @@ class RowPackedSaturationEngine:
                 def mesh_fused():
                     scfg = self._sparse_cfg
                     floor = scfg["capacity_floor"]
-                    self._fused_aot(
-                        self._fused_cfg["rounds"],
-                        (
-                            floor,
-                            floor if self._scan4 else 0,
-                            floor if self._scan6 else 0,
-                        ),
-                        self._fused_run_args(scfg, budget),
+                    caps = (
+                        floor,
+                        floor if self._scan4 else 0,
+                        floor if self._scan6 else 0,
                     )
+                    fargs = self._fused_run_args(scfg, budget)
+                    for k in self._fused_k_ladder(
+                        self._fused_cfg["rounds"],
+                        self._fused_cfg.get("adaptive", False),
+                    ):
+                        self._fused_aot(k, caps, fargs)
 
                 tasks.append(mesh_fused)
         if parallel is None:
@@ -4959,6 +4993,7 @@ class RowPackedSaturationEngine:
     def _saturate_fused(
         self, cfg, K, sp, rp, init_total, budget, observer,
         frontier_observer, pipeline_depth: int = 1,
+        adaptive: bool = False,
     ):
         """The K-round fused-window controller (ISSUE 17): each
         dispatch runs :meth:`_fused_exec` — up to K rounds of the
@@ -4987,6 +5022,16 @@ class RowPackedSaturationEngine:
           derived nothing; any speculative windows behind it retire
           only fixed-point idle rounds and are dropped unretired, like
           the adaptive controller's speculative dense rounds.
+
+        ``adaptive`` (the K-adaptive terminal window): each dispatch
+        picks its window size from the halving ladder K, K/2, ..., 2 —
+        the full K while the derivation tail is wide, smaller once the
+        tail's geometric decay (the OnlineEta signal) predicts fewer
+        remaining rounds than half a window would speculate.  Retired
+        rounds are byte-identical either way: the window size only
+        moves window BOUNDARIES (how many rounds run per dispatch),
+        never what any round computes — a wrong prediction costs
+        speculative idle rounds or extra window edges, not results.
 
         Pipelining speculates whole WINDOWS (depth windows in flight,
         chained on the previous window's device carries).  Unlike the
@@ -5018,9 +5063,11 @@ class RowPackedSaturationEngine:
         )
         latest = None  # newest dispatched window's future (pool mode)
         self.frontier_rounds = []
+        recent_deltas = deque(maxlen=8)  # K-adaptive decay signal
 
         def finish_round(st, changed):
             nonlocal converged
+            recent_deltas.append(st.derivations)
             FRONTIER_EVENTS.record(st)
             self.frontier_rounds.append(st)
             if frontier_observer is not None:
@@ -5060,9 +5107,26 @@ class RowPackedSaturationEngine:
                 jnp.asarray(iteration, i32),
             )
 
-        def dispatch_window(caps):
+        def pick_k():
+            """Window size for the NEXT dispatch.  Halve K down the
+            power-of-two ladder while half a window still covers the
+            decay-predicted remaining rounds; floor 2 (a 1-round
+            window pays fused overhead for per-round surfacing)."""
+            if not adaptive:
+                return K
+            from distel_tpu.obs.costmodel import geometric_tail_remaining
+
+            rem = geometric_tail_remaining(recent_deltas)
+            if rem is None:
+                return K
+            k = K
+            while k > 2 and k // 2 >= rem:
+                k //= 2
+            return k
+
+        def dispatch_window(caps, kw):
             nonlocal sp, rp, latest
-            exe = self._fused_aot(K, caps, fa)
+            exe = self._fused_aot(kw, caps, fa)
             t0 = time.perf_counter()
             if pool is None:
                 out = exe(sp, rp, *host_carry(), fa)
@@ -5273,7 +5337,7 @@ class RowPackedSaturationEngine:
                         # speculative window: same capacities as the
                         # last sync measure (wrong guesses surface as
                         # deterministic fallout, never a changed round)
-                        dispatch_window(cur_caps)
+                        dispatch_window(cur_caps, pick_k())
                     else:
                         status, rdone = retire_window()
                         if status == 2:
@@ -5289,7 +5353,7 @@ class RowPackedSaturationEngine:
                     break
                 # ---- pipeline drained: the synchronous sync point ----
                 cur_caps = pick_caps()
-                dispatch_window(cur_caps)
+                dispatch_window(cur_caps, pick_k())
         finally:
             if pool is not None:
                 pool.shutdown(wait=True)
@@ -5392,6 +5456,7 @@ class RowPackedSaturationEngine:
             sp, rp, iteration, total, converged = self._saturate_fused(
                 cfg, fk, sp, rp, init_total, budget, observer,
                 frontier_observer, pipeline_depth=pdepth,
+                adaptive=bool(kcfg.get("adaptive")),
             )
         elif cfg is not None and self._sparse_supported():
             sp, rp, iteration, total, converged = self._saturate_adaptive(

@@ -46,6 +46,32 @@ from typing import List, Optional, Sequence, Tuple
 #: converging).
 DEFAULT_ROUNDS_EXP = 0.3
 DEFAULT_SPR_EXP = 2.0
+#: compile-wall ~ n^0.7: BENCH_r03's 64k cold-minus-warm gap puts the
+#: compile near 60 s where compile300k_512_cold_r5.log measured
+#: 148-209 s at 300k — a ~3x wall over a ~4.7x size step
+DEFAULT_COMPILE_EXP = 0.7
+
+
+def geometric_tail_remaining(
+    deltas: Sequence[int], decay_ceiling: float = 0.98
+) -> Optional[int]:
+    """Remaining-rounds estimate from the derivation-curve tail: EL+
+    saturation frontiers drain roughly geometrically, so the median
+    decay ratio of recent per-round derivation deltas predicts how
+    many more rounds until the frontier empties.  None while the curve
+    is too short or not draining (ratio >= ``decay_ceiling``) —
+    extrapolating a growing curve would lie.  Shared by
+    :class:`OnlineEta` (the in-flight ETA) and the rowpacked engine's
+    K-adaptive fused terminal window."""
+    ds = [d for d in deltas if d > 0]
+    if len(ds) < 3:
+        return None
+    ratios = [b / a for a, b in zip(ds, ds[1:])]
+    r = statistics.median(ratios)
+    if r >= decay_ceiling:
+        return None
+    remaining = math.ceil(math.log(max(ds[-1], 2.0)) / -math.log(r))
+    return max(1, min(remaining, 100_000))
 
 
 @dataclass
@@ -79,8 +105,17 @@ class ProbeObs:
 
     @property
     def s_per_round(self) -> Optional[float]:
+        """Wall per round, NET of any recorded compile seconds: a cold
+        process's session wall includes the trace+compile roster, and
+        pooling that into s/round systematically over-prices every
+        warm (or artifact-farmed) launch.  When the recorded compile
+        is nonsensically >= the wall, the raw pairing stands — bad
+        splits must not zero the signal."""
         if self.rounds and self.wall_s:
-            return self.wall_s / self.rounds
+            w = self.wall_s - (self.compile_s or 0.0)
+            if w <= 0:
+                w = self.wall_s
+            return w / self.rounds
         return None
 
     @property
@@ -133,6 +168,11 @@ def _obs_from_probe_doc(doc: dict, source: str) -> List[ProbeObs]:
                 wall_s=float(doc["exec_wall_s"]),
                 # resumed records carry the chain's cumulative count
                 rounds_total=int(doc.get("iterations_total") or 0) or None,
+                # a record that split its compile out lets s_per_round
+                # price execution alone (and seeds the compile fit)
+                compile_s=float(
+                    doc.get("compile_s") or doc.get("step_compile_s") or 0
+                ) or None,
                 shards=shards,
             )
         )
@@ -200,11 +240,15 @@ def load_ledger_observations(path: str) -> List[ProbeObs]:
             r.get("run_id"): r for r in recs if r.get("ev") == "close"
         }
         wall = 0.0
+        compile_s = 0.0
         for op in opens:
             rid = op.get("run_id")
             close = closes.get(rid)
             if close is not None and close.get("wall_s"):
                 wall += float(close["wall_s"])
+                # sessions that split their compile wall out (cold
+                # starts) let s_per_round price execution alone
+                compile_s += float(close.get("compile_s") or 0.0)
             else:
                 tail = [r for r in rounds_ if r.get("run_id") == rid]
                 if tail and tail[-1].get("elapsed_s"):
@@ -228,6 +272,7 @@ def load_ledger_observations(path: str) -> List[ProbeObs]:
                 rounds_total=max(
                     int(r.get("round") or 0) for r in rounds_
                 ) or None,
+                compile_s=compile_s or None,
                 shards=shards,
             )
         )
@@ -301,6 +346,13 @@ class CostModel:
     rounds_exp: float
     spr_coef: float
     spr_exp: float
+    #: fitted compile-wall curve, a SEPARATE term from s/round: a cold
+    #: process pays it once before round 1, a warm process (in-registry
+    #: programs, or an AOT artifact farm covering the roster) pays
+    #: zero.  None when the basis holds no compile observation — the
+    #: prediction then prices execution only, as before.
+    compile_coef: Optional[float] = None
+    compile_exp: Optional[float] = None
     basis: List[dict] = field(default_factory=list)
     #: the mesh shape this model was fitted FOR: the shard count whose
     #: observations exclusively shaped the fit, or None when the basis
@@ -318,7 +370,17 @@ class CostModel:
         return self.spr_coef * float(n) ** self.spr_exp
 
     def predict_wall_s(self, n: int) -> float:
+        """Execution wall (rounds x s/round) — compile is priced by
+        :meth:`predict_compile_s` and added by the launch guard, which
+        knows whether the process will actually pay it."""
         return self.predict_rounds(n) * self.predict_seconds_per_round(n)
+
+    def predict_compile_s(self, n: int) -> float:
+        """The cold-process trace+compile wall (0.0 with no compile
+        observations in the basis)."""
+        if self.compile_coef is None or self.compile_exp is None:
+            return 0.0
+        return self.compile_coef * float(n) ** self.compile_exp
 
     def describe(self, n: int) -> dict:
         return {
@@ -328,6 +390,7 @@ class CostModel:
                 self.predict_seconds_per_round(n), 2
             ),
             "predicted_wall_s": round(self.predict_wall_s(n), 1),
+            "predicted_compile_s": round(self.predict_compile_s(n), 1),
             "rounds_fit": [round(self.rounds_coef, 6), round(self.rounds_exp, 4)],
             "spr_fit": [round(self.spr_coef, 10), round(self.spr_exp, 4)],
             "shards": self.shards,
@@ -341,6 +404,8 @@ class CostModel:
             "rounds_exp": self.rounds_exp,
             "spr_coef": self.spr_coef,
             "spr_exp": self.spr_exp,
+            "compile_coef": self.compile_coef,
+            "compile_exp": self.compile_exp,
             "shards": self.shards,
             "mixed_shards": self.mixed_shards,
             "basis": self.basis,
@@ -385,6 +450,18 @@ def fit_cost_model(
     spr_coef, spr_exp = _fit_power(
         [(o.n, o.s_per_round) for o in ex], DEFAULT_SPR_EXP
     )
+    # the compile fit pools ALL observations that recorded a compile
+    # wall (compile-only probes AND split exec records): compile cost
+    # is a property of the program roster at a size, not of the mesh
+    # selection above
+    cpts = [
+        (o.n, o.compile_s)
+        for o in observations
+        if o.n and o.compile_s
+    ]
+    compile_coef = compile_exp = None
+    if cpts:
+        compile_coef, compile_exp = _fit_power(cpts, DEFAULT_COMPILE_EXP)
     basis = [
         {
             "source": o.source,
@@ -396,7 +473,9 @@ def fit_cost_model(
         for o in ex
     ]
     return CostModel(
-        rounds_coef, rounds_exp, spr_coef, spr_exp, basis,
+        rounds_coef, rounds_exp, spr_coef, spr_exp,
+        compile_coef=compile_coef, compile_exp=compile_exp,
+        basis=basis,
         shards=(None if mixed or shards is None else int(shards)),
         mixed_shards=mixed,
     )
@@ -413,15 +492,24 @@ def guard_launch(
     n: int,
     budget_s: float,
     force: bool = False,
+    warm_artifacts: bool = False,
 ) -> dict:
     """The launch budget decision: predict the wall from the fitted
     model and decide whether the run fits ``budget_s``.  Returns the
     full decision record (the caller prints it and refuses on
     ``allowed=False``); with no model the launch is allowed but the
-    record says the prediction basis was empty."""
+    record says the prediction basis was empty.
+
+    ``warm_artifacts``: the launching process consumes an AOT artifact
+    farm (or an already-warm registry) covering its roster, so the
+    compile wall is ZERO — the fitted compile term is priced out of
+    the total instead of over-refusing the launch (the pre-farm bug:
+    compile seconds pooled into s/round charged every warm run a cold
+    compile per round)."""
     rec = {
         "budget_s": float(budget_s),
         "forced": bool(force),
+        "warm_artifacts": bool(warm_artifacts),
     }
     if model is None:
         rec.update(
@@ -431,12 +519,19 @@ def guard_launch(
         )
         return rec
     rec.update(model.describe(n))
-    fits = rec["predicted_wall_s"] <= budget_s
+    if warm_artifacts:
+        rec["predicted_compile_s"] = 0.0
+    rec["predicted_total_s"] = round(
+        rec["predicted_wall_s"] + rec["predicted_compile_s"], 1
+    )
+    fits = rec["predicted_total_s"] <= budget_s
     rec["fits"] = fits
     rec["allowed"] = bool(fits or force)
     if not fits:
         rec["reason"] = (
-            f"predicted wall {rec['predicted_wall_s']:.0f}s exceeds the "
+            f"predicted wall {rec['predicted_total_s']:.0f}s "
+            f"(exec {rec['predicted_wall_s']:.0f}s + compile "
+            f"{rec['predicted_compile_s']:.0f}s) exceeds the "
             f"stage budget {budget_s:.0f}s"
             + (" (forced past the guard)" if force else "; pass --force to override")
         )
@@ -468,15 +563,7 @@ class OnlineEta:
         self.rounds = 0
 
     def _tail_remaining(self) -> Optional[int]:
-        ds = [d for d in self._deltas if d > 0]
-        if len(ds) < 3:
-            return None
-        ratios = [b / a for a, b in zip(ds, ds[1:])]
-        r = statistics.median(ratios)
-        if r >= 0.98:
-            return None  # not draining: extrapolation would lie
-        remaining = math.ceil(math.log(max(ds[-1], 2.0)) / -math.log(r))
-        return max(1, min(remaining, 100_000))
+        return geometric_tail_remaining(self._deltas)
 
     def update(
         self, round_wall_s: float, deriv_delta: int

@@ -67,8 +67,11 @@ def warmup_text(
     Returns a record with the resolved ``bucket_signature`` and the
     build's :class:`~distel_tpu.runtime.instrumentation.CompileStats`
     fields (all ≈ 0 when the bucket was already warm)."""
+    from distel_tpu.core.artifacts import ARTIFACT_EVENTS
+
     config = config or ClassifierConfig()
     t0 = time.monotonic()
+    art0 = ARTIFACT_EVENTS.snapshot()
     idx = _index_text(text, config)
     if profile == "serve":
         from distel_tpu.core.incremental import rebuild_engine
@@ -98,11 +101,24 @@ def warmup_text(
         delta_recs = warm_delta_programs(
             config, engine, idx, mesh=mesh, max_iters=max_iters
         )
+    # AOT artifact farm attribution (ISSUE 18): how much of this
+    # corpus's roster came off / went into the installed farm — the
+    # farm-build summary sums the serialized counts and a consuming
+    # replica's warmup shows its rosters landing as artifact hits
+    art1 = ARTIFACT_EVENTS.snapshot()
+    art = {
+        k: art1[k] - art0[k]
+        for k in ("exe_hits", "hlo_hits", "serialized", "unserializable")
+    }
     return {
         "profile": profile,
         "concepts": idx.n_concepts,
         "links": idx.n_links,
         "wall_s": round(time.monotonic() - t0, 3),
+        "artifact_exe_hits": art["exe_hits"],
+        "artifact_hlo_hits": art["hlo_hits"],
+        "artifact_serialized": art["serialized"],
+        "artifact_unserializable": art["unserializable"],
         "sparse_programs": len(getattr(engine, "_sparse_builds", ())),
         "fused_programs": len(getattr(engine, "_fused_builds", ())),
         "delta_programs": len(delta_recs),

@@ -117,6 +117,20 @@ class ReplicaSupervisor:
 
     # ----------------------------------------------------------- spawns
 
+    def _farm_args(self) -> List[str]:
+        """The shared spill-dir artifact wire (ISSUE 18): when the farm
+        manifest sits at ``<spill_dir>/artifacts/manifest.json``, every
+        spawned/respawned replica consumes it automatically — an
+        autoscaled replica serves its first request with zero
+        trace/compile without the operator re-plumbing flags.  An
+        explicit ``--artifacts-dir`` in ``extra_args`` wins."""
+        if "--artifacts-dir" in self.extra_args:
+            return []
+        farm = os.path.join(self.spill_dir, "artifacts")
+        if os.path.exists(os.path.join(farm, "manifest.json")):
+            return ["--artifacts-dir", farm]
+        return []
+
     def _spawn(self, rid: str) -> None:
         log_path = os.path.join(self.log_dir, f"{rid}.log")
         log = open(log_path, "w", encoding="utf-8")
@@ -127,6 +141,7 @@ class ReplicaSupervisor:
                     "--host", self.host, "--port", "0",
                     "--replica-id", rid,
                     "--spill-dir", self.spill_dir,
+                    *self._farm_args(),
                     *self.extra_args,
                 ],
                 stdout=log,

@@ -374,6 +374,11 @@ class Metrics:
         self._hists: Dict[str, tuple] = {}
         #: callables returning {name → value}, one call per render pass
         self._gauge_groups: list = []
+        #: callables returning {name → cumulative value}, sampled once
+        #: per render pass and rendered as TYPE counter — for
+        #: process-global monotonic tallies owned outside the registry
+        #: (the program-cache and artifact-farm aggregates)
+        self._counter_groups: list = []
         #: name → (label_name, fn returning {label_value → value}) —
         #: live-sampled LABELED gauge families (one label dimension,
         #: e.g. ``distel_step_rule_seconds{rule=...}``)
@@ -432,6 +437,19 @@ class Metrics:
         with self._lock:
             self._gauge_groups.append(fn)
 
+    def counter_group(
+        self, fn: Callable[[], Dict[str, float]]
+    ) -> None:
+        """Register a group of live-sampled counters: ``fn`` returns
+        ``{name: cumulative_value}`` and is called once per render
+        pass.  The counter twin of :meth:`gauge_group`, for monotonic
+        process-global tallies that live outside this registry (e.g.
+        ``PROGRAMS.stats()`` / ``ARTIFACT_EVENTS.snapshot()``) — the
+        families render with ``TYPE counter`` and carry the ``_total``
+        naming discipline the exposition lint enforces."""
+        with self._lock:
+            self._counter_groups.append(fn)
+
     def observe(
         self,
         name: str,
@@ -468,6 +486,7 @@ class Metrics:
             }
             gauges = dict(self._gauges)
             groups = list(self._gauge_groups)
+            cgroups = list(self._counter_groups)
             labeled = dict(self._labeled_gauge_fns)
             hists = {
                 n: (b, {k: (list(c), s, cnt) for k, (c, s, cnt) in se.items()})
@@ -479,9 +498,16 @@ class Metrics:
                 gauges.update(fn())
             except Exception:  # a dying group must not kill /metrics
                 continue
+        for fn in cgroups:
+            try:
+                sampled = fn()
+            except Exception:  # a dying group must not kill /metrics
+                continue
+            for n, v in sampled.items():
+                counters.setdefault(n, {})[()] = float(v)
         gauges = dict(sorted(gauges.items()))
         lines = []
-        for name, series in counters.items():
+        for name, series in sorted(counters.items()):
             if name in helps:
                 lines.append(f"# HELP {name} {escape_help(helps[name])}")
             lines.append(f"# TYPE {name} counter")

@@ -71,6 +71,28 @@ def _file_sha256(path: str) -> str:
     return h.hexdigest()
 
 
+def _artifact_window():
+    """Per-request artifact attribution: snapshot the process-global
+    farm aggregate before the work, and stamp the per-tier hit delta
+    onto the response record after — the scheduler serializes writes
+    per ontology, so the window is attributable in practice even
+    though the aggregate is global."""
+    from distel_tpu.core.artifacts import ARTIFACT_EVENTS
+
+    before = ARTIFACT_EVENTS.snapshot()
+
+    def close(rec: dict) -> dict:
+        after = ARTIFACT_EVENTS.snapshot()
+        delta = {
+            k: after[k] - before[k] for k in ("exe_hits", "hlo_hits")
+        }
+        if any(delta.values()):
+            rec["artifact_hits"] = delta
+        return rec
+
+    return close
+
+
 class _Entry:
     __slots__ = (
         "oid", "inc", "warm_inc", "texts", "resident_bytes",
@@ -264,6 +286,7 @@ class OntologyRegistry:
             if oid in self._entries:
                 raise ValueError(f"ontology id already loaded: {oid}")
             entry = self._entries[oid] = _Entry(oid)
+        art = _artifact_window()
         try:
             with entry.lock:
                 inc = self._new_inc()
@@ -291,7 +314,7 @@ class OntologyRegistry:
         )
         if version is not None:
             rec["version"] = version
-        return rec
+        return art(rec)
 
     def delta(self, oid: str, texts: List[str]) -> dict:
         """Apply one or more delta texts as ONE increment (the
@@ -302,6 +325,7 @@ class OntologyRegistry:
         from distel_tpu.owl import loader as owl_loader
 
         entry = self._entry(oid)
+        art = _artifact_window()
         with entry.lock:
             self._check_live(entry)
             inc = self._resident(entry)
@@ -321,7 +345,7 @@ class OntologyRegistry:
         self.traffic.note_write(oid)
         self._note_path(inc)
         self._maybe_evict(keep=oid)
-        return rec
+        return art(rec)
 
     def retract(self, oid: str, text: str) -> dict:
         """Retract a previously-applied text and commit the DRed-repaired

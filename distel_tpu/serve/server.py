@@ -212,6 +212,14 @@ class ServeApp:
         warm_budget_bytes: Optional[int] = None,
     ):
         self.config = config or ClassifierConfig()
+        # ---- AOT artifact farm (ISSUE 18): install the distributable
+        # compiled-program registry BEFORE anything can build a program
+        # so every load/delta in this process resolves against it
+        from distel_tpu.core import artifacts as _artifacts
+
+        self.artifacts_install = _artifacts.install_from_config(
+            self.config
+        )
         self.default_deadline_s = deadline_s
         self.metrics = Metrics()
         self.phases = PhaseAggregate()
@@ -331,6 +339,37 @@ class ServeApp:
             "distel_warmup_programs_total",
             "bucket programs precompiled by the startup warmup",
         )
+        # ---- AOT artifact farm (ISSUE 18): program-registry churn +
+        # per-tier artifact attribution, live-sampled from the
+        # process-global aggregates (cumulative, so TYPE counter)
+        from distel_tpu.core.artifacts import ARTIFACT_EVENTS
+        from distel_tpu.core.program_cache import PROGRAMS
+
+        _ARTIFACT_COUNTERS = (
+            ("distel_program_cache_evictions_total", "evictions",
+             "compiled programs evicted from the in-process registry "
+             "by LRU capacity pressure"),
+            ("distel_artifact_exe_hits_total", "exe_hits",
+             "program builds served by a farm exe artifact (zero "
+             "trace, zero compile)"),
+            ("distel_artifact_hlo_hits_total", "hlo_hits",
+             "program builds covered by a farm hlo-cache artifact "
+             "(trace+lower paid, XLA pass skipped)"),
+            ("distel_artifact_misses_total", "misses",
+             "program builds the installed farm manifest did not cover"),
+            ("distel_artifact_rejected_total", "rejected",
+             "artifacts rejected at load/install (checksum, backend, "
+             "or jax-version mismatch) — fell back to a loud compile"),
+        )
+
+        def _artifact_counters():
+            snap = dict(ARTIFACT_EVENTS.snapshot())
+            snap["evictions"] = PROGRAMS.stats()["evictions"]
+            return {m: snap[k] for m, k, _ in _ARTIFACT_COUNTERS}
+
+        for metric, _, help_text in _ARTIFACT_COUNTERS:
+            self.metrics.describe(metric, help_text)
+        self.metrics.counter_group(_artifact_counters)
         # ---- read plane (query snapshots) + storage-tier accounting
         self.metrics.describe(
             "distel_read_seconds",

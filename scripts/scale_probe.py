@@ -162,6 +162,11 @@ def _parse_args(argv=None):
                          "(default: the repo's SCALE_r0*_probes.jsonl "
                          "+ runs/*.ledger.jsonl + this run's --ledger "
                          "history)")
+    ap.add_argument("--artifacts-dir", default=None,
+                    help="consume an AOT artifact farm (cli "
+                         "farm-build output): covered programs "
+                         "deserialize instead of compiling, and the "
+                         "launch guard drops its fitted compile term")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.resume_from and not args.execute:
@@ -254,6 +259,9 @@ def run_probe(args) -> None:
             guard = costmodel.guard_launch(
                 model, args.n_classes, args.stage_budget_s,
                 force=args.force,
+                # an attached artifact farm pays the compile wall at
+                # bake time, not in this stage's budget
+                warm_artifacts=bool(args.artifacts_dir),
             )
             # the basis is the argument FOR the refusal — print it
             print(json.dumps({"launch_guard": guard}), flush=True)
@@ -266,6 +274,15 @@ def run_probe(args) -> None:
     from distel_tpu.config import enable_compile_cache
 
     enable_compile_cache()
+    if args.artifacts_dir:
+        from distel_tpu.core import artifacts
+
+        print(
+            json.dumps(
+                {"artifacts": artifacts.install(args.artifacts_dir)}
+            ),
+            flush=True,
+        )
 
     from distel_tpu.core.indexing import index_ontology
     from distel_tpu.core.rowpacked_engine import RowPackedSaturationEngine

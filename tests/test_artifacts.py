@@ -1,0 +1,305 @@
+"""AOT artifact farm tests (ISSUE 18): cross-process reuse with
+``compile_s == 0.0`` and counted artifact hits, corrupt/wrong-env
+rejection falling back to a loud compile, bake idempotence, and the
+manifest trust chain.
+
+The farm is baked ONCE per module (in-process: an ArtifactStore sink
+on the PROGRAMS registry while a warmup builds the roster) and the
+consumers — a genuinely fresh subprocess, and in-process installs over
+a cleared registry — resolve against it.  Every assertion rides the
+counted ``ARTIFACT_EVENTS`` aggregate, never wall-clock.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from distel_tpu.config import ClassifierConfig
+from distel_tpu.core import artifacts
+from distel_tpu.core.artifacts import (
+    ARTIFACT_EVENTS,
+    ArtifactError,
+    ArtifactStore,
+)
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.core.program_cache import PROGRAMS
+from distel_tpu.runtime.taxonomy import extract_taxonomy
+from distel_tpu.runtime.warmup import warmup_texts
+
+BASE = """
+SubClassOf(A B)
+SubClassOf(B C)
+SubClassOf(C ObjectSomeValuesFrom(r D))
+SubClassOf(ObjectSomeValuesFrom(r D) E)
+SubClassOf(E F)
+"""
+
+DELTA = """
+SubClassOf(New0 A)
+SubClassOf(New0 ObjectSomeValuesFrom(r G))
+SubClassOf(G D)
+"""
+
+
+def _taxonomy_digest(inc) -> str:
+    tax = extract_taxonomy(inc.last_result)
+    return json.dumps(
+        {c: sorted(s) for c, s in tax.subsumers.items()}, sort_keys=True
+    )
+
+
+def _classify(fast_min=0):
+    inc = IncrementalClassifier()
+    inc._FAST_PATH_MIN_CONCEPTS = fast_min
+    inc.add_text(BASE)
+    inc.add_text(DELTA)
+    return inc
+
+
+@pytest.fixture(scope="module")
+def farm(tmp_path_factory):
+    """Bake the BASE/DELTA roster into a farm directory and return
+    ``(root, baseline_taxonomy_digest)``.  The baseline classify runs
+    WITHOUT an installed farm — it is the oracle every consumer's
+    closure must match byte-for-byte."""
+    root = str(tmp_path_factory.mktemp("farm"))
+    store = ArtifactStore(root, writable=True)
+    PROGRAMS.clear()
+    PROGRAMS.artifact_sink = store
+    try:
+        warmup_texts([BASE], ClassifierConfig(), parallel=False)
+        # the delta-plane helpers the fast path builds lazily (embed /
+        # live-bits / delta engines for THIS delta's bucket) ride the
+        # sink too: a full classify while the sink is attached puts the
+        # whole steady-state roster on the wire
+        baseline = _taxonomy_digest(_classify())
+    finally:
+        PROGRAMS.artifact_sink = None
+    assert store.written > 0
+    store.flush()
+    return root, baseline
+
+
+@pytest.fixture(autouse=True)
+def _detached():
+    """Every test starts and ends with no farm attached and a clean
+    event aggregate — these are process globals."""
+    artifacts.uninstall()
+    ARTIFACT_EVENTS.reset()
+    yield
+    artifacts.uninstall()
+    ARTIFACT_EVENTS.reset()
+
+
+# ------------------------------------------------------- cross-process
+
+_CONSUMER = r"""
+import json, sys
+from distel_tpu.core import artifacts
+from distel_tpu.core.artifacts import ARTIFACT_EVENTS
+from distel_tpu.core.incremental import IncrementalClassifier
+from distel_tpu.runtime.taxonomy import extract_taxonomy
+
+rec = artifacts.install(sys.argv[1], require=True)
+inc = IncrementalClassifier()
+inc._FAST_PATH_MIN_CONCEPTS = 0
+inc.add_text(%r)
+load = dict(inc.history[-1])
+inc.add_text(%r)
+delta = dict(inc.history[-1])
+tax = extract_taxonomy(inc.last_result)
+print(json.dumps({
+    "install": rec,
+    "load_compile_s": load["compile_s"],
+    "delta_compile_s": delta["compile_s"],
+    "delta_path": delta["path"],
+    "events": ARTIFACT_EVENTS.snapshot(),
+    "digest": json.dumps(
+        {c: sorted(s) for c, s in tax.subsumers.items()},
+        sort_keys=True,
+    ),
+}))
+""" % (BASE, DELTA)
+
+
+def test_cross_process_reuse_compiles_nothing(farm):
+    """THE acceptance scenario: a fresh process consuming the farm
+    serves load AND first delta with ``compile_s == 0.0``, counted exe
+    hits, zero rejections — and a byte-identical closure."""
+    root, baseline = farm
+    r = subprocess.run(
+        [sys.executable, "-c", _CONSUMER, root],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ),
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout.splitlines()[-1])
+    assert doc["install"]["installed"] is True
+    assert doc["load_compile_s"] == 0.0
+    assert doc["delta_compile_s"] == 0.0
+    assert doc["delta_path"] == "fast"
+    ev = doc["events"]
+    assert ev["exe_hits"] > 0, ev
+    assert ev["rejected"] == 0 and ev["misses"] == 0, ev
+    assert doc["digest"] == baseline
+
+
+# -------------------------------------------------- in-process install
+
+def test_installed_farm_serves_cleared_registry(farm):
+    """In-process: clear PROGRAMS, install the farm, classify — every
+    program deserializes (counted), nothing compiles, closure
+    identical."""
+    root, baseline = farm
+    PROGRAMS.clear()
+    rec = artifacts.install(root, require=True)
+    assert rec["installed"] is True
+    try:
+        inc = _classify()
+    finally:
+        artifacts.uninstall()
+    ev = ARTIFACT_EVENTS.snapshot()
+    assert ev["exe_hits"] > 0 and ev["rejected"] == 0
+    assert inc.history[0]["compile_s"] == 0.0
+    assert inc.history[-1]["compile_s"] == 0.0
+    assert _taxonomy_digest(inc) == baseline
+
+
+# --------------------------------------------------------- rejections
+
+def test_corrupt_artifact_falls_back_to_loud_compile(farm, tmp_path):
+    """Flipped bytes in every artifact file: each load rejects on the
+    sha256 check with a RuntimeWarning + a counted rejection, and the
+    classify compiles from scratch to the SAME closure — stale
+    artifacts cost time, never correctness."""
+    root, baseline = farm
+    bad = str(tmp_path / "bad-farm")
+    shutil.copytree(root, bad)
+    exe_dir = os.path.join(bad, "exe")
+    for name in os.listdir(exe_dir):
+        path = os.path.join(exe_dir, name)
+        with open(path, "r+b") as f:
+            blob = bytearray(f.read())
+            blob[len(blob) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(blob)
+    PROGRAMS.clear()
+    rec = artifacts.install(bad, require=True)
+    assert rec["installed"] is True  # manifest itself is intact
+    try:
+        with pytest.warns(RuntimeWarning, match="rejecting artifact"):
+            inc = _classify()
+    finally:
+        artifacts.uninstall()
+    ev = ARTIFACT_EVENTS.snapshot()
+    assert ev["rejected"] > 0 and ev["exe_hits"] == 0
+    assert _taxonomy_digest(inc) == baseline
+
+
+def _rewrite_manifest(root: str, dest: str, **overrides) -> None:
+    shutil.copytree(root, dest)
+    mpath = os.path.join(dest, artifacts.MANIFEST_NAME)
+    with open(mpath, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    doc.update(overrides)
+    doc["checksum"] = artifacts._manifest_digest(doc)
+    with open(mpath, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+
+
+def test_wrong_backend_manifest_refused(farm, tmp_path):
+    root, _ = farm
+    bad = str(tmp_path / "tpu-farm")
+    _rewrite_manifest(root, bad, backend="tpu")
+    with pytest.warns(RuntimeWarning, match="backend"):
+        rec = artifacts.install(bad)
+    assert rec["installed"] is False and "backend" in rec["reason"]
+    assert ARTIFACT_EVENTS.snapshot()["rejected"] == 1
+    # the process keeps compiling as if no farm existed
+    assert PROGRAMS.artifact_source is None
+    with pytest.raises(ArtifactError):
+        artifacts.install(bad, require=True)
+
+
+def test_wrong_jax_version_manifest_refused(farm, tmp_path):
+    root, _ = farm
+    bad = str(tmp_path / "pin-farm")
+    _rewrite_manifest(root, bad, jax_version="0.0.1")
+    with pytest.warns(RuntimeWarning, match="jax_version"):
+        rec = artifacts.install(bad)
+    assert rec["installed"] is False and "jax_version" in rec["reason"]
+    assert PROGRAMS.artifact_source is None
+
+
+def test_tampered_manifest_checksum_refused(farm, tmp_path):
+    """A manifest whose body no longer matches its whole-file digest is
+    untrusted wholesale — nothing in it loads."""
+    root, _ = farm
+    bad = str(tmp_path / "tampered-farm")
+    shutil.copytree(root, bad)
+    mpath = os.path.join(bad, artifacts.MANIFEST_NAME)
+    with open(mpath, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    doc["n_devices"] = 999  # checksum left stale
+    with open(mpath, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    with pytest.raises(ArtifactError, match="checksum"):
+        ArtifactStore(bad)
+    with pytest.warns(RuntimeWarning, match="NOT installed"):
+        rec = artifacts.install(bad)
+    assert rec["installed"] is False
+
+
+def test_missing_manifest_refused(tmp_path):
+    with pytest.raises(ArtifactError, match="farm-build"):
+        ArtifactStore(str(tmp_path / "nowhere"))
+
+
+# -------------------------------------------------------- idempotence
+
+def test_rebake_writes_nothing(farm):
+    """Second bake over the same roster: every key resolves off the
+    existing farm (source), the sink records nothing, the manifest
+    bytes do not change — ``farm-build`` is idempotent."""
+    root, _ = farm
+    mpath = os.path.join(root, artifacts.MANIFEST_NAME)
+    with open(mpath, "rb") as f:
+        before = f.read()
+    store = ArtifactStore(root, writable=True)
+    PROGRAMS.clear()
+    PROGRAMS.artifact_source = store
+    PROGRAMS.artifact_sink = store
+    try:
+        warmup_texts([BASE], ClassifierConfig(), parallel=False)
+        _classify()
+    finally:
+        PROGRAMS.artifact_sink = None
+        PROGRAMS.artifact_source = None
+    assert store.written == 0
+    assert store.flush() is False
+    with open(mpath, "rb") as f:
+        assert f.read() == before
+    ev = ARTIFACT_EVENTS.snapshot()
+    assert ev["serialized"] == 0 and ev["exe_hits"] > 0
+
+
+# -------------------------------------------------------------- units
+
+def test_artifact_id_is_stable_and_keyed_on_the_whole_key():
+    k1 = ("b4096x2240-abc", "run", 10000)
+    assert artifacts.artifact_id(k1) == artifacts.artifact_id(k1)
+    assert artifacts.artifact_id(k1) != artifacts.artifact_id(
+        ("b4096x2240-abc", "run", 20000)
+    )
+
+
+def test_describe_key_extracts_reporting_fields():
+    d = artifacts.describe_key(("b1-x", "fused", (4, 128, 0, 0)))
+    assert d["bucket_signature"] == "b1-x"
+    assert d["kind"] == "fused" and d["fused_k"] == 4
+    d = artifacts.describe_key(("b1-x", "sparse", (256, 0, 0)))
+    assert d["rung"] == [256, 0, 0]

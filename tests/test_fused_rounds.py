@@ -219,6 +219,72 @@ def test_fused_pipelined_matches_per_round(galen_idx):
     _assert_same_closure(res_b, res_f)
 
 
+# -------------------------------------------- K-adaptive terminal window
+
+
+def test_k1_adaptive_routes_per_round(galen_idx):
+    """fused.rounds.adaptive with K=1 is still the per-round adaptive
+    controller — identity holds, no fused windows dispatched."""
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_SPARSE)
+    delta = _dispatch_deltas()
+    _, k1_rounds, res_1 = _run(
+        galen_idx, sparse=_ALL_SPARSE,
+        fused={"rounds": 1, "adaptive": True},
+    )
+    d = delta()
+    assert k1_rounds == base_rounds
+    _assert_same_closure(res_b, res_1)
+    assert d["fused_windows"] == 0
+
+
+def test_k_adaptive_no_shrink_without_decay(galen_idx):
+    """The chain tail derives a CONSTANT 1/round — no geometric decay,
+    so the tail estimator abstains and adaptive K must dispatch plain
+    K=4 windows: the retired sequence matches the non-adaptive run
+    exactly."""
+    eng_f, f_rounds, res_f = _run(
+        galen_idx, sparse=_ALL_SPARSE, fused={"rounds": 4}
+    )
+    eng_a, a_rounds, res_a = _run(
+        galen_idx, sparse=_ALL_SPARSE,
+        fused={"rounds": 4, "adaptive": True},
+    )
+    assert a_rounds == f_rounds
+    _assert_same_closure(res_f, res_a)
+    assert [st.rounds_in_window for st in eng_a.frontier_rounds] == [
+        st.rounds_in_window for st in eng_f.frontier_rounds
+    ]
+
+
+def test_k_adaptive_shrinks_windows_byte_identically(
+    galen_idx, monkeypatch
+):
+    """Force the decay signal to claim ~1 round remaining: every
+    window shrinks down the ladder to the K=2 floor, and — window size
+    only moves window BOUNDARIES — the retired per-round sequence and
+    final closure still match the per-round controller byte for
+    byte."""
+    from distel_tpu.obs import costmodel
+
+    _, base_rounds, res_b = _run(galen_idx, sparse=_ALL_SPARSE)
+    monkeypatch.setattr(
+        costmodel, "geometric_tail_remaining", lambda deltas: 1
+    )
+    delta = _dispatch_deltas()
+    eng, f_rounds, res_f = _run(
+        galen_idx, sparse=_ALL_SPARSE,
+        fused={"rounds": 8, "adaptive": True},
+    )
+    d = delta()
+    assert f_rounds == base_rounds
+    _assert_same_closure(res_b, res_f)
+    assert d["fused_windows"] >= 1
+    # the shrink is observable: no window ever retires more than the
+    # floor K=2, where the non-adaptive K=8 run retires bigger windows
+    riws = [st.rounds_in_window for st in eng.frontier_rounds]
+    assert max(riws) <= 2
+
+
 # ------------------------------------------------------- mesh parity
 
 
@@ -276,15 +342,28 @@ def test_fused_mesh_pipelined(galen_idx, _devices):
 
 def test_fused_config_normalization():
     eng_cfg = RowPackedSaturationEngine._normalize_fused_cfg
-    assert eng_cfg(None) == {"enable": True, "rounds": 1}
-    assert eng_cfg(True) == {"enable": True, "rounds": 1}
+    off = {"enable": True, "rounds": 1, "adaptive": False}
+    assert eng_cfg(None) == off
+    assert eng_cfg(True) == off
     assert eng_cfg(False) is None
     assert eng_cfg({"rounds": 4})["rounds"] == 4
+    assert eng_cfg({"rounds": 4})["adaptive"] is False
+    assert eng_cfg({"rounds": 4, "adaptive": True})["adaptive"] is True
     assert eng_cfg({"enable": False, "rounds": 4}) is None
     with pytest.raises(ValueError):
         eng_cfg({"rounds": 0})
     with pytest.raises(ValueError):
         eng_cfg({"bogus": 1})
+
+
+def test_fused_k_ladder():
+    """The precompile/farm roster matches what pick_k can dispatch."""
+    lad = RowPackedSaturationEngine._fused_k_ladder
+    assert lad(8, False) == [8]
+    assert lad(8, True) == [8, 4, 2]
+    assert lad(4, True) == [4, 2]
+    assert lad(2, True) == [2]
+    assert lad(1, True) == [1]
 
 
 def test_fused_config_reaches_engine_through_make_engine(
@@ -294,11 +373,18 @@ def test_fused_config_reaches_engine_through_make_engine(
     from distel_tpu.runtime.classifier import make_engine
 
     props = tmp_path / "distel.properties"
-    props.write_text("fused.rounds.enable = true\nfused.rounds.k = 4\n")
+    props.write_text(
+        "fused.rounds.enable = true\nfused.rounds.k = 4\n"
+        "fused.rounds.adaptive = true\n"
+    )
     cfg = ClassifierConfig.from_properties(str(props))
-    assert cfg.fused_rounds_config() == {"enable": True, "rounds": 4}
+    assert cfg.fused_rounds_config() == {
+        "enable": True, "rounds": 4, "adaptive": True,
+    }
     engine = make_engine(cfg, galen_idx)
-    assert engine._fused_cfg == {"enable": True, "rounds": 4}
+    assert engine._fused_cfg == {
+        "enable": True, "rounds": 4, "adaptive": True,
+    }
     props.write_text("fused.rounds.enable = false\n")
     off = ClassifierConfig.from_properties(str(props))
     assert off.fused_rounds_config() is None
